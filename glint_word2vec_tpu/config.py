@@ -1,0 +1,130 @@
+"""Hyperparameter surface.
+
+Mirrors the reference's 16-knob surface (mllib/feature/ServerSideGlintWord2Vec.scala:67-244,
+ml/feature/ServerSideGlintWord2Vec.scala:40-222) with the same semantics and defaults, plus
+TPU-native knobs the reference had no analog for (mesh shape, pair-batch size, dtype, pallas).
+
+Reference defaults (mllib:67-81,251): vectorSize 100, learningRate 0.01875, numPartitions 1,
+numIterations 1, minCount 5, maxSentenceLength 1000, window 5, batchSize 50, n 5,
+subsampleRatio 1e-6, numParameterServers 5, parameterServerHost "", unigramTableSize 1e8,
+seed random.
+
+Knobs that existed only to work around the reference's Akka transport — the
+``batchSize * n * window <= 10000`` payload constraint (mllib:83-85,154-188) and
+``parameterServerHost``/``parameterServerConfig`` (mllib:196-231) — are accepted by the
+compat layer (:mod:`glint_word2vec_tpu.models.compat`) for drop-in familiarity but have no
+effect here: there is no RPC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class Word2VecConfig:
+    """Configuration for TPU-native word2vec training.
+
+    Attributes whose names differ from the reference keep a comment mapping them back.
+    """
+
+    # --- core hyperparameters (reference-parity; defaults mllib:67-81,251) ---
+    vector_size: int = 100          # vectorSize (mllib:67)
+    learning_rate: float = 0.01875  # stepSize/learningRate (mllib:68)
+    num_partitions: int = 1         # numPartitions (mllib:69) — scales the lr-decay clock
+                                    # (mllib:406-410); on TPU it is the data-parallel degree
+    num_iterations: int = 1         # numIterations (mllib:70)
+    min_count: int = 5              # minCount (mllib:76)
+    max_sentence_length: int = 1000  # maxSentenceLength (mllib:73,88-97)
+    window: int = 5                 # window (mllib:251)
+    batch_size: int = 50            # batchSize (mllib:74) — reference centers-per-minibatch;
+                                    # kept for decay/compat; device batching uses pairs_per_batch
+    negatives: int = 5              # n (mllib:75)
+    subsample_ratio: float = 1e-6   # subsampleRatio (mllib:77,190-194)
+    seed: int = 0                   # seed (mllib:71; random by default there, fixed here for
+                                    # reproducibility — sync training makes runs deterministic)
+
+    # --- sharding / deployment (replaces numParameterServers & PS plumbing) ---
+    num_model_shards: int = 1       # ≈ numParameterServers (mllib:78,204-212): how many ways
+                                    # the embedding rows are sharded over the mesh 'model' axis
+    num_data_shards: int = 1        # data-parallel degree over the mesh 'data' axis
+    mesh_shape: Optional[Tuple[int, int]] = None  # explicit (data, model) mesh; default derives
+                                                  # from num_data_shards × num_model_shards
+
+    # --- negative-sampling table (G7; mllib:81,234-244) ---
+    unigram_table_size: int = 100_000_000  # kept for compat; the alias sampler is O(2·vocab)
+                                           # and exact, so this only sizes the optional
+                                           # table-based sampler used in parity tests
+    sample_power: float = 0.75      # classic word2vec counts^0.75 (fork-side in the reference)
+
+    # --- TPU-native knobs (no reference analog) ---
+    pairs_per_batch: int = 8192     # (center, context) pairs per device step; the reference's
+                                    # RPC-bound batchSize*window pairs/minibatch becomes one
+                                    # large fixed-shape jit step
+    sigmoid_mode: str = "exact"     # "exact" = jax.nn.sigmoid; "clipped" mirrors the reference
+                                    # LUT clipping at |f| > 6 (mllib:246-248,292-302)
+    param_dtype: str = "float32"    # embedding storage dtype
+    compute_dtype: str = "float32"  # dot-product dtype ("bfloat16" rides the MXU)
+    use_pallas: bool = False        # fused Pallas SGNS kernel for the hot step
+    cbow: bool = False              # CBOW variant (context-mean → center) instead of skip-gram
+    shuffle: bool = True            # shuffle sentence order each iteration (reference order is
+                                    # whatever repartition() produced, i.e. arbitrary; mllib:345)
+
+    # --- lr decay semantics (mllib:405-413) ---
+    min_alpha_factor: float = 1e-4  # floor alpha at learning_rate * 1e-4 (mllib:410)
+    decay_interval_words: int = 10_000  # recompute alpha every 10k words (mllib:404)
+
+    def __post_init__(self) -> None:
+        if self.vector_size <= 0:
+            raise ValueError(f"vector_size must be positive but got {self.vector_size}")
+        if self.learning_rate <= 0:
+            raise ValueError(f"learning_rate must be positive but got {self.learning_rate}")
+        if self.num_partitions <= 0:
+            raise ValueError(f"num_partitions must be positive but got {self.num_partitions}")
+        if self.num_iterations < 0:
+            raise ValueError(
+                f"num_iterations must be nonnegative but got {self.num_iterations}")
+        if self.min_count < 0:
+            raise ValueError(f"min_count must be nonnegative but got {self.min_count}")
+        if self.max_sentence_length <= 0:
+            raise ValueError(
+                f"max_sentence_length must be positive but got {self.max_sentence_length}")
+        if self.window <= 0:
+            raise ValueError(f"window must be positive but got {self.window}")
+        if self.batch_size <= 0:
+            raise ValueError(f"batch_size must be positive but got {self.batch_size}")
+        if self.negatives <= 0:
+            raise ValueError(f"negatives must be positive but got {self.negatives}")
+        if not (0 < self.subsample_ratio <= 1):
+            raise ValueError(
+                f"subsample_ratio must be in (0, 1] but got {self.subsample_ratio}")
+        if self.unigram_table_size <= 0:
+            raise ValueError(
+                f"unigram_table_size must be positive but got {self.unigram_table_size}")
+        if self.pairs_per_batch <= 0:
+            raise ValueError(
+                f"pairs_per_batch must be positive but got {self.pairs_per_batch}")
+        if self.sigmoid_mode not in ("exact", "clipped"):
+            raise ValueError(
+                f"sigmoid_mode must be 'exact' or 'clipped' but got {self.sigmoid_mode!r}")
+        if self.num_model_shards <= 0:
+            raise ValueError(
+                f"num_model_shards must be positive but got {self.num_model_shards}")
+        if self.num_data_shards <= 0:
+            raise ValueError(
+                f"num_data_shards must be positive but got {self.num_data_shards}")
+
+    def replace(self, **kwargs) -> "Word2VecConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Word2VecConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        clean = {k: v for k, v in d.items() if k in fields}
+        if "mesh_shape" in clean and clean["mesh_shape"] is not None:
+            clean["mesh_shape"] = tuple(clean["mesh_shape"])
+        return cls(**clean)

@@ -1,0 +1,18 @@
+from glint_word2vec_tpu.data.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.data.pipeline import (
+    encode_sentences,
+    subsample_sentence,
+    dynamic_window_pairs,
+    PairBatcher,
+    epoch_batches,
+)
+
+__all__ = [
+    "Vocabulary",
+    "build_vocab",
+    "encode_sentences",
+    "subsample_sentence",
+    "dynamic_window_pairs",
+    "PairBatcher",
+    "epoch_batches",
+]

@@ -1,0 +1,99 @@
+"""Vocabulary builder (reference component C1).
+
+Reimplements ``learnVocab`` (mllib/feature/ServerSideGlintWord2Vec.scala:258-279): count
+words, drop those with count < min_count, sort by descending count, assign indices in that
+order, and record the total count of retained training words (``trainWordsCount``).
+
+The reference does this as a Spark word-count job with a driver-side collect; here it is a
+single-pass host-side counter. Multi-host corpora shard by file and merge counters
+(:func:`merge_counts`).
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Sequence
+
+import numpy as np
+
+
+@dataclass
+class Vocabulary:
+    """Immutable vocabulary: words sorted by descending corpus frequency.
+
+    ``words[i]`` has count ``counts[i]``; ``index[word] == i``. Matches the reference's
+    contract that word index order == matrix row order == descending frequency
+    (mllib:261-279, save sidecar order mllib:495-496).
+    """
+
+    words: List[str]
+    counts: np.ndarray  # int64 [vocab_size]
+    index: Dict[str, int] = field(repr=False)
+    train_words_count: int = 0
+
+    @property
+    def size(self) -> int:
+        return len(self.words)
+
+    def __len__(self) -> int:
+        return len(self.words)
+
+    def __contains__(self, word: str) -> bool:
+        return word in self.index
+
+    def get(self, word: str, default: int = -1) -> int:
+        return self.index.get(word, default)
+
+    @classmethod
+    def from_words_and_counts(cls, words: Sequence[str], counts: Sequence[int]) -> "Vocabulary":
+        counts = np.asarray(counts, dtype=np.int64)
+        index = {w: i for i, w in enumerate(words)}
+        return cls(words=list(words), counts=counts, index=index,
+                   train_words_count=int(counts.sum()))
+
+    @classmethod
+    def from_counter(cls, counter: "collections.Counter[str]", min_count: int) -> "Vocabulary":
+        items = [(w, c) for w, c in counter.items() if c >= min_count]
+        if not items:
+            raise ValueError(
+                "The vocabulary size should be > 0. You may need to check the setting of "
+                "min_count, which could be large enough to remove all your words in sentences.")
+        # Descending count; stable on first-seen order for ties (the reference's sortWith is
+        # likewise stable, mllib:266).
+        items.sort(key=lambda wc: -wc[1])
+        words = [w for w, _ in items]
+        counts = np.fromiter((c for _, c in items), dtype=np.int64, count=len(items))
+        index = {w: i for i, w in enumerate(words)}
+        return cls(words=words, counts=counts, index=index,
+                   train_words_count=int(counts.sum()))
+
+
+def count_words(sentences: Iterable[Sequence[str]]) -> "collections.Counter[str]":
+    counter: "collections.Counter[str]" = collections.Counter()
+    for sentence in sentences:
+        counter.update(sentence)
+    return counter
+
+
+def merge_counts(counters: Iterable["collections.Counter[str]"]) -> "collections.Counter[str]":
+    total: "collections.Counter[str]" = collections.Counter()
+    for c in counters:
+        total.update(c)
+    return total
+
+
+def build_vocab(sentences: Iterable[Sequence[str]], min_count: int = 5) -> Vocabulary:
+    """Count → filter(min_count) → sort desc → index (mllib:258-279)."""
+    return Vocabulary.from_counter(count_words(sentences), min_count)
+
+
+def read_corpus(path: str, lowercase: bool = False) -> Iterator[List[str]]:
+    """Whitespace-tokenized line-per-sentence reader (the format of the reference's toy
+    corpus, which ships pre-tokenized and lowercased; it spec:22-37)."""
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            yield [t.lower() for t in toks] if lowercase else toks

@@ -1,0 +1,19 @@
+from glint_word2vec_tpu.ops.sampler import AliasTable, build_alias_table, sample_negatives
+from glint_word2vec_tpu.ops.sgns import (
+    init_embeddings,
+    sgns_loss,
+    sgns_step,
+    cbow_step,
+    alpha_schedule,
+)
+
+__all__ = [
+    "AliasTable",
+    "build_alias_table",
+    "sample_negatives",
+    "init_embeddings",
+    "sgns_loss",
+    "sgns_step",
+    "cbow_step",
+    "alpha_schedule",
+]

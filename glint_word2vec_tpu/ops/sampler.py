@@ -1,0 +1,112 @@
+"""On-device unigram negative sampler (replaces reference component G7).
+
+The reference materializes a server-resident unigram table of ``unigramTableSize`` entries
+(default 10^8 — 400 MB of int32; mllib:81,234-244, built fork-side from broadcast vocab
+counts, mllib:317,355-359) and draws negatives by indexing it with a shared seed so every
+parameter-server shard samples identical negatives without communicating them (G3 contract,
+mllib:419-421).
+
+TPU-native replacement: a **Walker alias table** over the counts^0.75 unigram distribution —
+O(2·vocab) memory instead of O(table_size), *exact* (no quantization), sampled fully
+on-device with ``jax.random`` in O(1) per draw. The shared-seed trick survives as ordinary
+functional PRNG: every device derives the same per-step key, so data-parallel replicas and
+model shards agree on negatives for free.
+
+A quantized table-based sampler (:func:`build_unigram_table`) is kept for distribution-parity
+tests against the classic word2vec table semantics.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class AliasTable(NamedTuple):
+    """Walker alias method tables for a categorical distribution over vocab rows.
+
+    prob[i] ∈ [0,1]: probability of keeping bucket i's own index; alias[i]: the index drawn
+    otherwise. Both shape [vocab_size]; small and replicable across the mesh.
+    """
+
+    prob: jax.Array   # float32 [V]
+    alias: jax.Array  # int32 [V]
+
+    @property
+    def vocab_size(self) -> int:
+        return self.prob.shape[0]
+
+
+def build_alias_table(counts: np.ndarray, power: float = 0.75) -> AliasTable:
+    """Build alias tables for p(w) ∝ counts[w]^power (classic word2vec 3/4 power).
+
+    Host-side Vose construction, vectorized: each round pairs k small buckets with k
+    distinct large buckets at once (every element is finalized exactly once, so total work
+    is O(V) array ops across a handful of rounds — fast enough to rebuild at 10M vocab).
+    """
+    counts = np.asarray(counts, dtype=np.float64)
+    if counts.ndim != 1 or counts.size == 0:
+        raise ValueError("counts must be a nonempty 1-D array")
+    weights = np.power(np.maximum(counts, 0.0), power)
+    total = weights.sum()
+    if total <= 0:
+        raise ValueError("all counts are zero")
+    V = counts.size
+    scaled = weights * (V / total)  # mean 1.0
+    prob = np.ones(V, dtype=np.float64)
+    alias = np.arange(V, dtype=np.int64)
+
+    small = np.flatnonzero(scaled < 1.0)
+    large = np.flatnonzero(scaled >= 1.0)
+    while small.size and large.size:
+        k = min(small.size, large.size)
+        s, small = small[:k], small[k:]
+        l = large[:k]
+        prob[s] = scaled[s]
+        alias[s] = l
+        scaled[l] -= 1.0 - scaled[s]
+        now_small = l[scaled[l] < 1.0]
+        large = np.concatenate([l[scaled[l] >= 1.0], large[k:]])
+        small = np.concatenate([small, now_small])
+    # leftovers are numerically ≈1: keep their own index
+    prob[small] = 1.0
+    prob[large] = 1.0
+    return AliasTable(
+        prob=jnp.asarray(prob, dtype=jnp.float32),
+        alias=jnp.asarray(alias, dtype=jnp.int32),
+    )
+
+
+def sample_negatives(
+    table: AliasTable, key: jax.Array, shape: Tuple[int, ...]
+) -> jax.Array:
+    """Draw negative word indices with p ∝ counts^power, fully on-device, any shape.
+
+    Two uniforms per draw: bucket u1·V, then keep-vs-alias on u2 < prob[bucket].
+    """
+    k1, k2 = jax.random.split(key)
+    V = table.vocab_size
+    buckets = jax.random.randint(k1, shape, 0, V, dtype=jnp.int32)
+    u = jax.random.uniform(k2, shape, dtype=jnp.float32)
+    keep = u < table.prob[buckets]
+    return jnp.where(keep, buckets, table.alias[buckets])
+
+
+def sampled_probabilities(counts: np.ndarray, power: float = 0.75) -> np.ndarray:
+    """Exact target distribution, for tests: p(w) = counts^power / Σ counts^power."""
+    w = np.power(np.asarray(counts, dtype=np.float64), power)
+    return w / w.sum()
+
+
+def build_unigram_table(counts: np.ndarray, table_size: int, power: float = 0.75) -> np.ndarray:
+    """Classic word2vec quantized unigram table (the reference's G7 semantics,
+    unigramTableSize entries, mllib:81,234-244): entry j holds the word whose cumulative
+    counts^power mass covers j/table_size. Kept for parity testing only — the alias sampler
+    is exact and O(vocab)."""
+    p = sampled_probabilities(counts, power)
+    cdf = np.cumsum(p)
+    grid = (np.arange(table_size, dtype=np.float64) + 0.5) / table_size
+    return np.searchsorted(cdf, grid).astype(np.int32)

@@ -1,0 +1,250 @@
+"""Fused SGNS/CBOW training step — the TPU-native replacement for the reference's hot loop.
+
+In the reference, one minibatch costs two network round-trips to the parameter servers:
+``dotprod(wInput, wOutput, seed)`` computes positive/negative dot products server-side
+(G3, mllib:419-421), the client turns them into scalar gradient coefficients through a
+1000-entry sigmoid LUT (``getSigmoid``, mllib:292-302), and ``adjust(gPlus, gMinus,
+cacheKeys)`` applies the scatter-updates server-side (G4, mllib:423-425), pipelined at most
+one minibatch deep (mllib:428-429).
+
+Here the whole thing is one jitted function: embedding gather → batched dots → sigmoid →
+scatter-add updates, with negatives sampled on-device (:mod:`..ops.sampler`). Under jit the
+``dotprod``/``adjust`` split disappears; under pjit the per-shard partial dot products of the
+CIKM'16 scheme become XLA collectives inserted by GSPMD.
+
+Update rule (SGD on the SGNS objective, identical to the reference's coefficients):
+
+    f_pos = syn0[c]·syn1[x]          g_pos = (1 − σ(f_pos))·α
+    f_neg = syn0[c]·syn1[z_k]        g_neg = (0 − σ(f_neg))·α
+    syn0[c]    += g_pos·syn1[x] + Σ_k g_neg_k·syn1[z_k]
+    syn1[x]    += g_pos·syn0[c]
+    syn1[z_k]  += g_neg_k·syn0[c]
+
+using the *pre-update* values on both sides, exactly like the server-side cache in the
+reference (the ``cacheKeys`` minibatch cache exists to reuse the dotprod-time rows in
+adjust). Duplicate indices within a batch accumulate via scatter-add — deterministic,
+unlike the reference's accepted Hogwild races (README.md:17-19).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glint_word2vec_tpu.ops.sampler import AliasTable, sample_negatives
+
+MAX_EXP = 6.0  # the reference's LUT clipping range (mllib:247, EXP_TABLE_SIZE/MAX_EXP)
+
+
+class EmbeddingPair(NamedTuple):
+    """The two trainable matrices: input (syn0) and output (syn1neg) embeddings —
+    the reference's ``BigWord2VecMatrix`` pair (G2, README.md:69)."""
+
+    syn0: jax.Array  # [V, D] input embeddings — the word vectors the model exports
+    syn1: jax.Array  # [V, D] output embeddings — negative-sampling softmax weights
+
+
+class StepMetrics(NamedTuple):
+    """Per-step training telemetry — superset of the reference's heartbeat, which logs
+    wordCount/alpha/fPlus(0) every 10k words (mllib:411-412)."""
+
+    loss: jax.Array       # masked mean SGNS loss
+    mean_f_pos: jax.Array  # mean positive dot product (gradient-health signal)
+    pairs: jax.Array      # number of real (unmasked) pairs in the batch
+
+
+def init_embeddings(
+    vocab_size: int,
+    vector_size: int,
+    key: jax.Array,
+    dtype: jnp.dtype = jnp.float32,
+) -> EmbeddingPair:
+    """Classic word2vec init: syn0 ~ U(-0.5/D, 0.5/D), syn1 = 0 (fork-side in the
+    reference; standard for SGNS — zero syn1 makes initial dots 0, σ=0.5)."""
+    syn0 = jax.random.uniform(
+        key, (vocab_size, vector_size), dtype=jnp.float32,
+        minval=-0.5 / vector_size, maxval=0.5 / vector_size).astype(dtype)
+    syn1 = jnp.zeros((vocab_size, vector_size), dtype=dtype)
+    return EmbeddingPair(syn0=syn0, syn1=syn1)
+
+
+def _sigmoid(f: jax.Array, mode: str) -> jax.Array:
+    """σ(f); "clipped" mirrors the reference LUT saturation: σ=1 for f>6, σ=0 for f<-6
+    (getSigmoid, mllib:292-302), which zeroes gradients outside ±6."""
+    if mode == "clipped":
+        return jnp.where(f > MAX_EXP, 1.0,
+                         jnp.where(f < -MAX_EXP, 0.0, jax.nn.sigmoid(f)))
+    return jax.nn.sigmoid(f)
+
+
+def _log_sigmoid(f: jax.Array) -> jax.Array:
+    return -jax.nn.softplus(-f)
+
+
+def sgns_loss(
+    params: EmbeddingPair,
+    centers: jax.Array,     # int32 [B]
+    contexts: jax.Array,    # int32 [B]
+    negatives: jax.Array,   # int32 [B, n]
+    mask: jax.Array,        # float32 [B]
+    compute_dtype: jnp.dtype = jnp.float32,
+) -> jax.Array:
+    """Masked-mean SGNS negative log likelihood:
+    −log σ(f_pos) − Σ_k log σ(−f_neg_k). ∂loss/∂f gives exactly the reference's gradient
+    coefficients (up to the α scale), so SGD-via-autodiff on this loss and the manual
+    :func:`sgns_step` agree — a property the unit tests assert.
+    """
+    e_in = params.syn0[centers].astype(compute_dtype)
+    e_pos = params.syn1[contexts].astype(compute_dtype)
+    e_neg = params.syn1[negatives].astype(compute_dtype)
+    f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)
+    f_neg = jnp.einsum("bd,bnd->bn", e_in, e_neg).astype(jnp.float32)
+    neg_valid = (negatives != contexts[:, None]).astype(jnp.float32) * mask[:, None]
+    per_pair = -_log_sigmoid(f_pos) * mask - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return per_pair.sum() / denom
+
+
+def sgns_step(
+    params: EmbeddingPair,
+    centers: jax.Array,    # int32 [B]
+    contexts: jax.Array,   # int32 [B]
+    mask: jax.Array,       # float32 [B]
+    key: jax.Array,
+    alpha: jax.Array,      # scalar learning rate (already decayed)
+    table: AliasTable,
+    num_negatives: int,
+    sigmoid_mode: str = "exact",
+    compute_dtype: jnp.dtype = jnp.float32,
+) -> Tuple[EmbeddingPair, StepMetrics]:
+    """One synchronous SGNS update on a fixed-shape batch of (center, context) pairs.
+
+    Negatives equal to their pair's positive context word are skipped (zero gradient), the
+    classic word2vec rule the fork's server-side sampler follows. Padded pairs (mask 0)
+    contribute nothing: their coefficients are multiplied by the mask before scatter.
+    """
+    syn0, syn1 = params
+    B = centers.shape[0]
+    negatives = sample_negatives(table, key, (B, num_negatives))
+    neg_valid = (negatives != contexts[:, None]).astype(jnp.float32) * mask[:, None]
+
+    e_in = syn0[centers].astype(compute_dtype)          # [B, D]
+    e_pos = syn1[contexts].astype(compute_dtype)        # [B, D]
+    e_neg = syn1[negatives].astype(compute_dtype)       # [B, n, D]
+
+    f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)          # [B]
+    f_neg = jnp.einsum("bd,bnd->bn", e_in, e_neg).astype(jnp.float32)   # [B, n]
+
+    # Gradient coefficients, exactly the reference's client-side math (mllib:421-425):
+    # gPlus = (1 − σ(f))·α for label 1, gMinus = (0 − σ(f))·α for label 0.
+    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask               # [B]
+    g_neg = (0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid          # [B, n]
+
+    gp = g_pos[:, None].astype(compute_dtype)
+    gn = g_neg[..., None].astype(compute_dtype)
+    d_in = gp * e_pos + jnp.einsum("bn,bnd->bd", g_neg.astype(compute_dtype), e_neg)
+    d_pos = gp * e_in                                   # [B, D]
+    d_neg = gn * e_in[:, None, :]                       # [B, n, D]
+
+    dtype = syn0.dtype
+    new_syn0 = syn0.at[centers].add(d_in.astype(dtype))
+    new_syn1 = syn1.at[contexts].add(d_pos.astype(dtype))
+    D = syn1.shape[1]
+    new_syn1 = new_syn1.at[negatives.reshape(-1)].add(
+        d_neg.reshape(-1, D).astype(dtype))
+
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (-_log_sigmoid(f_pos) * mask
+            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1)).sum() / denom
+    metrics = StepMetrics(
+        loss=loss,
+        mean_f_pos=(f_pos * mask).sum() / denom,
+        pairs=mask.sum(),
+    )
+    return EmbeddingPair(new_syn0, new_syn1), metrics
+
+
+def cbow_step(
+    params: EmbeddingPair,
+    centers: jax.Array,     # int32 [B] — predicted (output) words
+    contexts: jax.Array,    # int32 [B, C] — context window, padded
+    ctx_mask: jax.Array,    # float32 [B, C]
+    mask: jax.Array,        # float32 [B]
+    key: jax.Array,
+    alpha: jax.Array,
+    table: AliasTable,
+    num_negatives: int,
+    sigmoid_mode: str = "exact",
+    compute_dtype: jnp.dtype = jnp.float32,
+) -> Tuple[EmbeddingPair, StepMetrics]:
+    """CBOW variant (BASELINE config 5): input = mean of context vectors, output = center.
+
+    hidden = mean_c syn0[context_c]; positives are the centers, negatives sampled per
+    example. Context-vector gradients are the hidden gradient divided equally (mean
+    convention), scattered back to every context position.
+    """
+    syn0, syn1 = params
+    B, C = contexts.shape
+    negatives = sample_negatives(table, key, (B, num_negatives))
+    neg_valid = (negatives != centers[:, None]).astype(jnp.float32) * mask[:, None]
+
+    e_ctx = syn0[contexts].astype(compute_dtype)                      # [B, C, D]
+    ctx_m = ctx_mask.astype(compute_dtype)[..., None]
+    ctx_n = jnp.maximum(ctx_mask.sum(axis=-1), 1.0).astype(compute_dtype)  # [B]
+    hidden = (e_ctx * ctx_m).sum(axis=1) / ctx_n[:, None]             # [B, D]
+
+    e_out = syn1[centers].astype(compute_dtype)                       # [B, D]
+    e_neg = syn1[negatives].astype(compute_dtype)                     # [B, n, D]
+    f_pos = jnp.sum(hidden * e_out, axis=-1).astype(jnp.float32)
+    f_neg = jnp.einsum("bd,bnd->bn", hidden, e_neg).astype(jnp.float32)
+
+    has_ctx = (ctx_mask.sum(axis=-1) > 0).astype(jnp.float32)
+    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask * has_ctx
+    g_neg = (0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid * has_ctx[:, None]
+
+    gp = g_pos[:, None].astype(compute_dtype)
+    d_hidden = gp * e_out + jnp.einsum("bn,bnd->bd", g_neg.astype(compute_dtype), e_neg)
+    # mean convention: each context word gets d_hidden / |context|
+    d_ctx = (d_hidden / ctx_n[:, None])[:, None, :] * ctx_m           # [B, C, D]
+    d_out = gp * hidden
+    d_neg = g_neg[..., None].astype(compute_dtype) * hidden[:, None, :]
+
+    dtype = syn0.dtype
+    D = syn0.shape[1]
+    new_syn0 = syn0.at[contexts.reshape(-1)].add(d_ctx.reshape(-1, D).astype(dtype))
+    new_syn1 = syn1.at[centers].add(d_out.astype(dtype))
+    new_syn1 = new_syn1.at[negatives.reshape(-1)].add(d_neg.reshape(-1, D).astype(dtype))
+
+    denom = jnp.maximum((mask * has_ctx).sum(), 1.0)
+    neg_live = neg_valid * has_ctx[:, None]
+    loss = (-_log_sigmoid(f_pos) * mask * has_ctx
+            - jnp.sum(_log_sigmoid(-f_neg) * neg_live, axis=-1)).sum() / denom
+    metrics = StepMetrics(
+        loss=loss,
+        mean_f_pos=(f_pos * mask * has_ctx).sum() / denom,
+        pairs=(mask * has_ctx).sum(),
+    )
+    return EmbeddingPair(new_syn0, new_syn1), metrics
+
+
+def alpha_schedule(
+    words_processed,
+    total_words: float,
+    learning_rate: float,
+    min_alpha_factor: float = 1e-4,
+):
+    """Linear lr decay with floor — the reference's schedule (mllib:405-413):
+    ``alpha = lr · (1 − words_processed/total)``, floored at ``lr · 1e-4``, where
+    ``total = numIterations · trainWordsCount + 1`` and words_processed is the global clock
+    (the reference approximates it as ``numPartitions · wordCount_partition + prior_iters``).
+    Works on Python floats and jnp scalars alike.
+    """
+    progress = words_processed / total_words
+    alpha = learning_rate * (1.0 - progress)
+    floor = learning_rate * min_alpha_factor
+    if isinstance(alpha, (float, int)):
+        return max(float(alpha), floor)
+    return jnp.maximum(alpha, floor)

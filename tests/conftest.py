@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX backends initialize.
+
+Tests exercise the multi-chip sharding path the same way the reference exercises
+"multi-node" behavior inside a single Docker container (build.sbt:48-77): by faking the
+topology — here with XLA's host-platform device-count flag instead of Docker.
+
+Note: the session image registers a remote-TPU PJRT plugin in sitecustomize and pins
+``jax_platforms`` programmatically, so setting JAX_PLATFORMS alone is not enough — we also
+update the jax config after import (backends are still uninitialized at conftest time).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+REFERENCE_CORPUS = "/root/reference/de_wikipedia_articles_country_capitals.txt"
+
+
+@pytest.fixture(scope="session")
+def toy_corpus_path():
+    if not os.path.exists(REFERENCE_CORPUS):
+        pytest.skip("reference toy corpus not available")
+    return REFERENCE_CORPUS

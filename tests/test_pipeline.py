@@ -1,0 +1,165 @@
+"""Unit tests for the host data pipeline (C4/C5/C6): indexing, subsampling formula,
+dynamic-window generation, fixed-shape batching."""
+
+import numpy as np
+
+from glint_word2vec_tpu.data.pipeline import (
+    PairBatcher,
+    count_train_words,
+    dynamic_window_pairs,
+    encode_sentences,
+    epoch_batches,
+    keep_probabilities,
+    subsample_sentence,
+)
+from glint_word2vec_tpu.data.vocab import build_vocab
+
+
+def _vocab():
+    sents = [["a", "b", "c", "d", "e"] * 4, ["a", "b", "f"] * 3]
+    return build_vocab(sents, min_count=1), sents
+
+
+def test_encode_drops_oov_and_chunks():
+    vocab, _ = _vocab()
+    enc = encode_sentences([["a", "zzz", "b", "c"]], vocab, max_sentence_length=2)
+    # OOV 'zzz' dropped, remaining 3 ids chunked into [2, 1]
+    assert [e.shape[0] for e in enc] == [2, 1]
+    flat = np.concatenate(enc)
+    assert [vocab.words[i] for i in flat] == ["a", "b", "c"]
+
+
+def test_encode_skips_empty():
+    vocab, _ = _vocab()
+    assert encode_sentences([["zzz"], []], vocab) == []
+
+
+def test_keep_probabilities_formula():
+    # keep = (sqrt(pct/ratio)+1)*(ratio/pct), pct = cn/total — intended float semantics of
+    # mllib:374-377 (the reference's integer division makes it a no-op; see pipeline.py).
+    counts = np.array([1000, 10, 1])
+    total = 1011
+    ratio = 1e-3
+    keep = keep_probabilities(counts, total, ratio)
+    pct = counts / total
+    expected = np.minimum((np.sqrt(pct / ratio) + 1) * (ratio / pct), 1.0)
+    np.testing.assert_allclose(keep, expected)
+    # frequent words are dropped more
+    assert keep[0] < keep[1] <= keep[2] == 1.0
+
+
+def test_subsample_extremes():
+    rng = np.random.default_rng(0)
+    sent = np.arange(10, dtype=np.int32)
+    keep_all = np.ones(10)
+    np.testing.assert_array_equal(subsample_sentence(sent, keep_all, rng), sent)
+    keep_none = np.zeros(10)
+    # draws <= 0.0 has probability ~0
+    assert subsample_sentence(sent, keep_none, rng).size == 0
+
+
+def test_dynamic_window_legacy_asymmetric():
+    # Reference (mllib:384-388): context = [max(0,i-b), min(i+b, len)) \ {i} — the upper
+    # bound is exclusive, so right context has b-1 words. Verify against brute force.
+    rng_draws = np.random.default_rng(42)
+    L, window = 23, 5
+    sent = np.arange(100, 100 + L, dtype=np.int32)
+
+    # reproduce internal rng: same seed → same b draws
+    rng = np.random.default_rng(7)
+    b = np.random.default_rng(7).integers(0, window, size=L)
+    centers, contexts = dynamic_window_pairs(sent, window, np.random.default_rng(7))
+
+    exp_c, exp_x = [], []
+    for i in range(L):
+        for p in range(max(0, i - int(b[i])), min(i + int(b[i]), L)):
+            if p != i:
+                exp_c.append(sent[i])
+                exp_x.append(sent[p])
+    np.testing.assert_array_equal(centers, np.array(exp_c, np.int32))
+    np.testing.assert_array_equal(contexts, np.array(exp_x, np.int32))
+
+
+def test_dynamic_window_symmetric():
+    L, window = 17, 4
+    sent = np.arange(L, dtype=np.int32)
+    b = np.random.default_rng(3).integers(0, window, size=L)
+    centers, contexts = dynamic_window_pairs(
+        sent, window, np.random.default_rng(3), legacy_asymmetric_window=False)
+    exp_c, exp_x = [], []
+    for i in range(L):
+        for p in range(max(0, i - int(b[i])), min(i + int(b[i]) + 1, L)):
+            if p != i:
+                exp_c.append(i)
+                exp_x.append(p)
+    np.testing.assert_array_equal(centers, exp_c)
+    np.testing.assert_array_equal(contexts, exp_x)
+
+
+def test_dynamic_window_empty_and_single():
+    rng = np.random.default_rng(0)
+    c, x = dynamic_window_pairs(np.empty(0, np.int32), 5, rng)
+    assert c.size == 0 and x.size == 0
+    c, x = dynamic_window_pairs(np.array([3], np.int32), 5, rng)
+    assert c.size == 0
+
+
+def test_pair_batcher_fixed_shapes():
+    batcher = PairBatcher(8)
+    batcher.add(np.arange(5, dtype=np.int32), np.arange(5, dtype=np.int32))
+    assert list(batcher.drain()) == []
+    batcher.add(np.arange(10, dtype=np.int32), np.arange(10, dtype=np.int32))
+    full = list(batcher.drain())
+    assert len(full) == 1 and full[0][0].shape == (8,) and full[0][2] == 8
+    tail = list(batcher.drain(flush=True))
+    assert len(tail) == 1
+    c, x, n = tail[0]
+    assert c.shape == (8,) and n == 7  # 15 total − 8 drained
+
+
+def test_epoch_batches_end_to_end_shapes_and_determinism():
+    vocab, sents = _vocab()
+    enc = encode_sentences(sents, vocab)
+
+    def run():
+        return list(epoch_batches(
+            enc, vocab, pairs_per_batch=16, window=3, subsample_ratio=1.0,
+            seed=11, iteration=1, shard=0, num_shards=1))
+
+    b1, b2 = run(), run()
+    assert len(b1) >= 1
+    for a, b in zip(b1, b2):
+        np.testing.assert_array_equal(a.centers, b.centers)
+        np.testing.assert_array_equal(a.contexts, b.contexts)
+        np.testing.assert_array_equal(a.mask, b.mask)
+    for batch in b1:
+        assert batch.centers.shape == (16,)
+        assert batch.mask.shape == (16,)
+        assert batch.num_real_pairs == int(batch.mask.sum())
+    # different iteration → different stream
+    b3 = list(epoch_batches(
+        enc, vocab, pairs_per_batch=16, window=3, subsample_ratio=1.0,
+        seed=11, iteration=2, shard=0, num_shards=1))
+    assert any(not np.array_equal(a.centers, b.centers) for a, b in zip(b1, b3))
+
+
+def test_epoch_batches_sharding_partitions_sentences():
+    vocab, sents = _vocab()
+    enc = encode_sentences(sents * 4, vocab)
+    # With subsample_ratio=1.0 every word is kept, so the shards' words_seen clocks must
+    # partition the corpus exactly (pair counts differ: window shrink draws are per-shard).
+    def words_seen(shard, num_shards):
+        last = 0
+        for b in epoch_batches(
+                enc, vocab, pairs_per_batch=8, window=2, subsample_ratio=1.0,
+                seed=5, shard=shard, num_shards=num_shards, shuffle=False):
+            last = b.words_seen
+        return last
+
+    total = sum(int(s.shape[0]) for s in enc)
+    assert words_seen(0, 1) == total
+    assert words_seen(0, 2) + words_seen(1, 2) == total
+
+
+def test_count_train_words():
+    assert count_train_words([np.arange(3), np.arange(4)]) == 7

@@ -1,0 +1,66 @@
+"""Unit tests for the on-device unigram sampler (G7 replacement): distribution ∝ counts^0.75."""
+
+import jax
+import numpy as np
+
+from glint_word2vec_tpu.ops.sampler import (
+    build_alias_table,
+    build_unigram_table,
+    sample_negatives,
+    sampled_probabilities,
+)
+
+
+def test_alias_table_shapes_and_validity():
+    counts = np.array([100, 50, 10, 1, 1])
+    t = build_alias_table(counts)
+    assert t.prob.shape == (5,) and t.alias.shape == (5,)
+    assert np.all(np.asarray(t.prob) >= 0) and np.all(np.asarray(t.prob) <= 1)
+    assert np.all(np.asarray(t.alias) >= 0) and np.all(np.asarray(t.alias) < 5)
+
+
+def test_alias_table_exactly_encodes_power_distribution():
+    # Reconstruct p from (prob, alias): p[i] = (prob[i] + Σ_j (1−prob[j])[alias_j == i]) / V
+    counts = np.array([1000, 300, 50, 7, 3, 1, 1, 1])
+    t = build_alias_table(counts, power=0.75)
+    prob = np.asarray(t.prob, dtype=np.float64)
+    alias = np.asarray(t.alias)
+    V = counts.size
+    p = prob.copy()
+    np.add.at(p, alias, 1.0 - prob)
+    p /= V
+    np.testing.assert_allclose(p, sampled_probabilities(counts, 0.75), atol=1e-6)
+
+
+def test_sample_negatives_distribution():
+    counts = np.array([500, 200, 100, 10, 5])
+    t = build_alias_table(counts, power=0.75)
+    draws = sample_negatives(t, jax.random.key(0), (200_000,))
+    freq = np.bincount(np.asarray(draws), minlength=5) / 200_000
+    np.testing.assert_allclose(freq, sampled_probabilities(counts, 0.75), atol=0.01)
+
+
+def test_sample_negatives_deterministic_per_key():
+    counts = np.arange(1, 101)
+    t = build_alias_table(counts)
+    a = sample_negatives(t, jax.random.key(7), (64, 5))
+    b = sample_negatives(t, jax.random.key(7), (64, 5))
+    c = sample_negatives(t, jax.random.key(8), (64, 5))
+    assert a.shape == (64, 5)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_quantized_table_matches_alias_distribution():
+    # The reference's G7 table (unigramTableSize entries) and the alias sampler encode the
+    # same counts^0.75 distribution, up to table quantization.
+    counts = np.array([900, 400, 100, 30, 9, 2])
+    table = build_unigram_table(counts, table_size=100_000)
+    table_freq = np.bincount(table, minlength=6) / table.size
+    np.testing.assert_allclose(table_freq, sampled_probabilities(counts, 0.75), atol=1e-3)
+
+
+def test_single_word_vocab():
+    t = build_alias_table(np.array([42]))
+    draws = sample_negatives(t, jax.random.key(0), (16,))
+    assert np.all(np.asarray(draws) == 0)

@@ -1,0 +1,220 @@
+"""Property tests for the fused SGNS/CBOW step.
+
+The key property: the manual scatter-update step equals SGD-via-autodiff on the SGNS loss
+(with the same pre-drawn negatives) — the reference could never test this (async Hogwild
+races, SURVEY §4); synchronous training makes it exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.ops.sampler import build_alias_table, sample_negatives
+from glint_word2vec_tpu.ops.sgns import (
+    EmbeddingPair,
+    alpha_schedule,
+    cbow_step,
+    init_embeddings,
+    sgns_loss,
+    sgns_step,
+)
+
+V, D, B, N = 50, 16, 32, 5
+
+
+@pytest.fixture(scope="module")
+def setup():
+    key = jax.random.key(0)
+    params = init_embeddings(V, D, key)
+    # make syn1 nonzero so gradients flow everywhere
+    params = EmbeddingPair(
+        syn0=params.syn0,
+        syn1=jax.random.normal(jax.random.key(1), (V, D)) * 0.1,
+    )
+    counts = np.arange(V, 0, -1) ** 2
+    table = build_alias_table(counts)
+    rng = np.random.default_rng(0)
+    centers = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+    contexts = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+    mask = jnp.ones(B, jnp.float32)
+    return params, table, centers, contexts, mask
+
+
+def test_manual_step_matches_autodiff_sgd(setup):
+    params, table, centers, contexts, mask = setup
+    alpha = 0.05
+    step_key = jax.random.key(42)
+    new_params, metrics = sgns_step(
+        params, centers, contexts, mask, step_key, alpha, table, N)
+
+    negatives = sample_negatives(table, step_key, (B, N))
+    denom = jnp.maximum(mask.sum(), 1.0)
+    grads = jax.grad(
+        lambda p: sgns_loss(p, centers, contexts, negatives, mask) * denom)(params)
+    exp_syn0 = params.syn0 - alpha * grads.syn0
+    exp_syn1 = params.syn1 - alpha * grads.syn1
+    np.testing.assert_allclose(np.asarray(new_params.syn0), np.asarray(exp_syn0),
+                               atol=1e-6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_params.syn1), np.asarray(exp_syn1),
+                               atol=1e-6, rtol=1e-5)
+    assert float(metrics.pairs) == B
+
+
+def test_masked_pairs_do_not_update(setup):
+    params, table, centers, contexts, _ = setup
+    mask = jnp.zeros(B, jnp.float32)
+    new_params, metrics = sgns_step(
+        params, centers, contexts, mask, jax.random.key(0), 0.1, table, N)
+    np.testing.assert_array_equal(np.asarray(new_params.syn0), np.asarray(params.syn0))
+    np.testing.assert_array_equal(np.asarray(new_params.syn1), np.asarray(params.syn1))
+    assert float(metrics.pairs) == 0.0
+
+
+def test_partial_mask_matches_smaller_batch(setup):
+    params, table, centers, contexts, _ = setup
+    # Batch with the last half masked == batch of just the first half, with the caveat that
+    # negatives are drawn per-slot; use the same key and compare only syn0 rows untouched by
+    # negatives' e_in scatter — simplest exact check: masked-slot contributions are zero, so
+    # rows appearing ONLY in masked slots are unchanged.
+    mask = jnp.concatenate([jnp.ones(B // 2), jnp.zeros(B // 2)]).astype(jnp.float32)
+    new_params, _ = sgns_step(
+        params, centers, contexts, mask, jax.random.key(3), 0.1, table, N)
+    live = set(np.asarray(centers[: B // 2]).tolist())
+    dead = set(np.asarray(centers[B // 2:]).tolist()) - live
+    for row in dead:
+        np.testing.assert_array_equal(
+            np.asarray(new_params.syn0[row]), np.asarray(params.syn0[row]))
+
+
+def test_duplicate_indices_accumulate(setup):
+    params, table, *_ = setup
+    centers = jnp.zeros(B, jnp.int32)  # every pair hits row 0
+    contexts = jnp.ones(B, jnp.int32)
+    mask = jnp.ones(B, jnp.float32)
+    new_params, _ = sgns_step(
+        params, centers, contexts, mask, jax.random.key(5), 0.05, table, N)
+    # update to row 0 must equal B times the single-pair update (same context, same e values
+    # pre-update, negatives differ per slot — so compare against per-slot sum via autodiff)
+    negatives = sample_negatives(table, jax.random.key(5), (B, N))
+    grads = jax.grad(
+        lambda p: sgns_loss(p, centers, contexts, negatives, mask) * B)(params)
+    np.testing.assert_allclose(
+        np.asarray(new_params.syn0[0]),
+        np.asarray(params.syn0[0] - 0.05 * grads.syn0[0]), atol=1e-6, rtol=1e-5)
+
+
+def test_clipped_sigmoid_saturates(setup):
+    _, table, centers, contexts, mask = setup
+    # Huge positive dots → σ=1 → zero positive gradient under "clipped" mode (reference LUT
+    # behavior, mllib:292-302).
+    big = EmbeddingPair(
+        syn0=jnp.ones((V, D)) * 10.0,
+        syn1=jnp.ones((V, D)) * 10.0,
+    )
+    new_params, _ = sgns_step(
+        big, centers, contexts, mask, jax.random.key(0), 0.1, table, N,
+        sigmoid_mode="clipped")
+    # positive grad is exactly 0; negative grad is exactly -1·α (σ clipped to 1 for f>6)
+    # so syn1[context] rows get only the positive-side update = 0 + possible negative hits.
+    # Check f_pos path: rows used only as centers changed solely via negative coefficients;
+    # with all-equal embeddings every update direction is identical — simply assert finite
+    # and that clipped mode differs from exact mode.
+    exact_params, _ = sgns_step(
+        big, centers, contexts, mask, jax.random.key(0), 0.1, table, N,
+        sigmoid_mode="exact")
+    assert np.all(np.isfinite(np.asarray(new_params.syn0)))
+    # σ_exact(200) ≈ 1 to float precision too, so exact vs clipped agree at saturation
+    np.testing.assert_allclose(np.asarray(new_params.syn0),
+                               np.asarray(exact_params.syn0), atol=1e-4)
+
+
+def test_negatives_colliding_with_positive_are_skipped():
+    # Vocab of 1: every negative == the context word → all negative grads masked out.
+    params = EmbeddingPair(syn0=jnp.ones((1, 4)) * 0.1, syn1=jnp.ones((1, 4)) * 0.1)
+    table = build_alias_table(np.array([10]))
+    centers = jnp.zeros(8, jnp.int32)
+    contexts = jnp.zeros(8, jnp.int32)
+    mask = jnp.ones(8, jnp.float32)
+    new_params, metrics = sgns_step(
+        params, centers, contexts, mask, jax.random.key(0), 0.1, table, 5)
+    # only the positive-pair gradient applied; loss = -log σ(f_pos) only
+    f = float(jnp.sum(params.syn0[0] * params.syn1[0]))
+    expected_loss = -np.log(1.0 / (1.0 + np.exp(-f)))
+    np.testing.assert_allclose(float(metrics.loss), expected_loss, rtol=1e-5)
+
+
+def test_training_reduces_loss(setup):
+    params, table, *_ = setup
+    rng = np.random.default_rng(1)
+    # deterministic corpus: word i co-occurs with i+1 mod 10 within first 10 words
+    c = jnp.asarray(rng.integers(0, 10, 256), jnp.int32)
+    x = (c + 1) % 10
+    mask = jnp.ones(256, jnp.float32)
+    step = jax.jit(lambda p, k: sgns_step(p, c, x, mask, k, 0.02, table, N))
+    losses = []
+    for i in range(60):
+        params, m = step(params, jax.random.key(i))
+        losses.append(float(m.loss))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_cbow_step_basics(setup):
+    params, table, *_ = setup
+    rng = np.random.default_rng(2)
+    Bc, C = 64, 6
+    centers = jnp.asarray(rng.integers(0, V, Bc), jnp.int32)
+    contexts = jnp.asarray(rng.integers(0, V, (Bc, C)), jnp.int32)
+    ctx_mask = jnp.asarray(rng.integers(0, 2, (Bc, C)), jnp.float32)
+    mask = jnp.ones(Bc, jnp.float32)
+    first = last = None
+    for i in range(30):
+        params, m = cbow_step(
+            params, centers, contexts, ctx_mask, mask, jax.random.key(i), 0.1, table, N)
+        if first is None:
+            first = float(m.loss)
+        last = float(m.loss)
+    assert np.isfinite(last) and last < first
+
+
+def test_cbow_masked_batch_no_update(setup):
+    params, table, *_ = setup
+    centers = jnp.zeros(8, jnp.int32)
+    contexts = jnp.zeros((8, 4), jnp.int32)
+    ctx_mask = jnp.ones((8, 4), jnp.float32)
+    mask = jnp.zeros(8, jnp.float32)
+    new_params, _ = cbow_step(
+        params, centers, contexts, ctx_mask, mask, jax.random.key(0), 0.1, table, N)
+    np.testing.assert_array_equal(np.asarray(new_params.syn0), np.asarray(params.syn0))
+
+
+def test_cbow_empty_context_no_update(setup):
+    params, table, *_ = setup
+    centers = jnp.arange(8, dtype=jnp.int32)
+    contexts = jnp.zeros((8, 4), jnp.int32)
+    ctx_mask = jnp.zeros((8, 4), jnp.float32)  # no context at all
+    mask = jnp.ones(8, jnp.float32)
+    new_params, m = cbow_step(
+        params, centers, contexts, ctx_mask, mask, jax.random.key(0), 0.1, table, N)
+    np.testing.assert_array_equal(np.asarray(new_params.syn0), np.asarray(params.syn0))
+    np.testing.assert_array_equal(np.asarray(new_params.syn1), np.asarray(params.syn1))
+    # loss telemetry must also ignore empty-context rows entirely
+    assert float(m.loss) == 0.0
+
+
+def test_alpha_schedule_reference_semantics():
+    # alpha = lr·(1−progress), floor lr·1e-4 (mllib:405-413)
+    lr = 0.025
+    assert alpha_schedule(0, 1000, lr) == pytest.approx(lr)
+    assert alpha_schedule(500, 1000, lr) == pytest.approx(lr * 0.5)
+    assert alpha_schedule(2000, 1000, lr) == pytest.approx(lr * 1e-4)
+    # jnp path
+    a = alpha_schedule(jnp.asarray(500.0), 1000.0, lr)
+    np.testing.assert_allclose(float(a), lr * 0.5)
+
+
+def test_init_embeddings_ranges():
+    p = init_embeddings(V, D, jax.random.key(0))
+    s0 = np.asarray(p.syn0)
+    assert s0.max() <= 0.5 / D and s0.min() >= -0.5 / D
+    assert np.all(np.asarray(p.syn1) == 0)

@@ -1,0 +1,52 @@
+"""Unit tests for the vocabulary builder (C1) — tests the reference never had
+(its only suite is the Docker integration spec, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.data.vocab import Vocabulary, build_vocab, count_words, merge_counts
+
+
+SENTS = [
+    "the quick brown fox jumps over the lazy dog".split(),
+    "the dog barks at the fox".split(),
+    "a quick dog".split(),
+]
+
+
+def test_build_vocab_sorted_desc_and_counts():
+    v = build_vocab(SENTS, min_count=1)
+    # descending counts
+    assert all(v.counts[i] >= v.counts[i + 1] for i in range(len(v) - 1))
+    assert v.words[0] == "the" and v.counts[0] == 4
+    assert v.train_words_count == sum(len(s) for s in SENTS)
+    # index round-trips
+    for i, w in enumerate(v.words):
+        assert v.index[w] == i
+
+
+def test_min_count_filters():
+    v = build_vocab(SENTS, min_count=2)
+    assert "jumps" not in v
+    assert "dog" in v and "the" in v
+    assert v.train_words_count == int(v.counts.sum())
+
+
+def test_empty_vocab_raises():
+    with pytest.raises(ValueError, match="vocabulary size should be > 0"):
+        build_vocab(SENTS, min_count=100)
+
+
+def test_merge_counts_matches_single_pass():
+    c1 = count_words(SENTS[:1])
+    c2 = count_words(SENTS[1:])
+    merged = merge_counts([c1, c2])
+    assert merged == count_words(SENTS)
+
+
+def test_from_words_and_counts_roundtrip():
+    v = build_vocab(SENTS, min_count=1)
+    v2 = Vocabulary.from_words_and_counts(v.words, v.counts)
+    assert v2.words == v.words
+    assert np.array_equal(v2.counts, v.counts)
+    assert v2.train_words_count == v.train_words_count
