@@ -31,6 +31,12 @@ pallas kernels), ``parallel/`` (mesh + sharding), ``models/`` (model & estimator
 
 from glint_word2vec_tpu.config import Word2VecConfig
 from glint_word2vec_tpu.data.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.models import (
+    ServerSideGlintWord2Vec,
+    ServerSideGlintWord2VecModel,
+    Word2Vec,
+    Word2VecModel,
+)
 
 __version__ = "0.1.0"
 
@@ -38,5 +44,9 @@ __all__ = [
     "Word2VecConfig",
     "Vocabulary",
     "build_vocab",
+    "Word2Vec",
+    "Word2VecModel",
+    "ServerSideGlintWord2Vec",
+    "ServerSideGlintWord2VecModel",
     "__version__",
 ]

@@ -41,7 +41,12 @@ class Word2VecConfig:
     batch_size: int = 50            # batchSize (mllib:74) — reference centers-per-minibatch;
                                     # kept for decay/compat; device batching uses pairs_per_batch
     negatives: int = 5              # n (mllib:75)
-    subsample_ratio: float = 1e-6   # subsampleRatio (mllib:77,190-194)
+    subsample_ratio: float = 0.0    # subsampleRatio (mllib:77,190-194). 0 disables.
+                                    # NOTE: the reference's default is 1e-6, but its
+                                    # formula divides Int/Long (mllib:374-376) so its
+                                    # subsampling is a silent no-op — "disabled" IS the
+                                    # reference's observed behavior. Setting >0 here uses
+                                    # the intended float formula (pipeline.py).
     seed: int = 0                   # seed (mllib:71; random by default there, fixed here for
                                     # reproducibility — sync training makes runs deterministic)
 
@@ -64,6 +69,13 @@ class Word2VecConfig:
                                     # large fixed-shape jit step
     sigmoid_mode: str = "exact"     # "exact" = jax.nn.sigmoid; "clipped" mirrors the reference
                                     # LUT clipping at |f| > 6 (mllib:246-248,292-302)
+    duplicate_scaling: bool = False  # opt-in stabilizer: average (not sum) a row's updates
+                                     # over its in-batch multiplicity. Off by default —
+                                     # textbook word2vec semantics; realistic vocabs have
+                                     # low duplicate density after subsampling. Turn on for
+                                     # tiny-vocab/large-batch regimes where summed
+                                     # duplicates would diverge (slows differentiation;
+                                     # see ops/sgns.py)
     param_dtype: str = "float32"    # embedding storage dtype
     compute_dtype: str = "float32"  # dot-product dtype ("bfloat16" rides the MXU)
     use_pallas: bool = False        # fused Pallas SGNS kernel for the hot step
@@ -96,9 +108,9 @@ class Word2VecConfig:
             raise ValueError(f"batch_size must be positive but got {self.batch_size}")
         if self.negatives <= 0:
             raise ValueError(f"negatives must be positive but got {self.negatives}")
-        if not (0 < self.subsample_ratio <= 1):
+        if not (0 <= self.subsample_ratio <= 1):
             raise ValueError(
-                f"subsample_ratio must be in (0, 1] but got {self.subsample_ratio}")
+                f"subsample_ratio must be in [0, 1] but got {self.subsample_ratio}")
         if self.unigram_table_size <= 0:
             raise ValueError(
                 f"unigram_table_size must be positive but got {self.unigram_table_size}")

@@ -1,18 +1,23 @@
-from glint_word2vec_tpu.data.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.data.vocab import Vocabulary, build_vocab, read_corpus
 from glint_word2vec_tpu.data.pipeline import (
     encode_sentences,
     subsample_sentence,
     dynamic_window_pairs,
+    dynamic_window_cbow,
     PairBatcher,
     epoch_batches,
+    epoch_batches_cbow,
 )
 
 __all__ = [
     "Vocabulary",
     "build_vocab",
+    "read_corpus",
     "encode_sentences",
     "subsample_sentence",
     "dynamic_window_pairs",
+    "dynamic_window_cbow",
     "PairBatcher",
     "epoch_batches",
+    "epoch_batches_cbow",
 ]

@@ -61,10 +61,24 @@ def keep_probabilities(
 ) -> np.ndarray:
     """Per-word keep probability ``(sqrt(pct/ratio)+1)*(ratio/pct)`` (intended semantics of
     mllib:374-377; see module docstring for the reference's integer-division bug)."""
+    if subsample_ratio <= 0:
+        return np.ones(counts.shape[0], dtype=np.float64)  # disabled (the reference's
+        # observed behavior at any setting, due to its integer-division bug)
     pct = counts.astype(np.float64) / float(train_words_count)
     ratio = float(subsample_ratio)
     keep = (np.sqrt(pct / ratio) + 1.0) * (ratio / pct)
     return np.minimum(keep, 1.0)
+
+
+def expected_kept_words(
+    counts: np.ndarray, train_words_count: int, subsample_ratio: float
+) -> int:
+    """Expected number of words surviving subsampling per iteration — the lr-decay clock
+    total. The reference uses the raw trainWordsCount (mllib:363) because its subsampling
+    keeps everything (no-op bug); with real subsampling the clock must count what the
+    stream actually yields or alpha never reaches its floor."""
+    keep = keep_probabilities(counts, train_words_count, subsample_ratio)
+    return int(np.round((counts * keep).sum()))
 
 
 def subsample_sentence(
@@ -132,46 +146,54 @@ class PairBatch:
 
 
 class PairBatcher:
-    """Accumulates ragged pair streams into fixed-size batches."""
+    """Accumulates N parallel ragged streams into fixed-size batches along axis 0.
 
-    def __init__(self, pairs_per_batch: int):
+    Used with 2 streams (centers, contexts) for skip-gram and 3 (centers, contexts [B,C],
+    ctx_mask [B,C]) for CBOW — one implementation of the accumulate / slice-full-batches /
+    carry-remainder / pad-last invariants.
+    """
+
+    def __init__(self, pairs_per_batch: int, num_streams: int = 2):
         self.B = int(pairs_per_batch)
-        self._centers: List[np.ndarray] = []
-        self._contexts: List[np.ndarray] = []
+        self.num_streams = num_streams
+        self._bufs: List[List[np.ndarray]] = [[] for _ in range(num_streams)]
         self._buffered = 0
 
-    def add(self, centers: np.ndarray, contexts: np.ndarray) -> None:
-        if centers.size == 0:
+    def add(self, *arrays: np.ndarray) -> None:
+        assert len(arrays) == self.num_streams
+        if arrays[0].shape[0] == 0:
             return
-        self._centers.append(centers)
-        self._contexts.append(contexts)
-        self._buffered += centers.size
+        for buf, arr in zip(self._bufs, arrays):
+            buf.append(arr)
+        self._buffered += arrays[0].shape[0]
 
-    def _pop_full(self) -> Iterator[Tuple[np.ndarray, np.ndarray, int]]:
+    def _pop_full(self) -> Iterator[Tuple]:
         if self._buffered < self.B:
             return
-        c = np.concatenate(self._centers)
-        x = np.concatenate(self._contexts)
-        n_full = c.size // self.B
+        cats = [np.concatenate(buf) for buf in self._bufs]
+        n_full = cats[0].shape[0] // self.B
         for i in range(n_full):
             sl = slice(i * self.B, (i + 1) * self.B)
-            yield c[sl], x[sl], self.B
-        rest_c, rest_x = c[n_full * self.B:], x[n_full * self.B:]
-        self._centers = [rest_c] if rest_c.size else []
-        self._contexts = [rest_x] if rest_x.size else []
-        self._buffered = rest_c.size
+            yield (*(c[sl] for c in cats), self.B)
+        rest = [c[n_full * self.B:] for c in cats]
+        self._buffered = rest[0].shape[0]
+        self._bufs = [[r] if self._buffered else [] for r in rest]
 
-    def drain(self, flush: bool = False) -> Iterator[Tuple[np.ndarray, np.ndarray, int]]:
+    def drain(self, flush: bool = False) -> Iterator[Tuple]:
+        """Yields ``(*stream_slices, num_real)`` tuples of exactly B rows each. With
+        ``flush``, the remainder is zero-padded to B and ``num_real < B`` marks it."""
         yield from self._pop_full()
         if flush and self._buffered:
-            c = np.concatenate(self._centers)
-            x = np.concatenate(self._contexts)
-            n = c.size
+            cats = [np.concatenate(buf) for buf in self._bufs]
+            n = cats[0].shape[0]
             pad = self.B - n
-            c = np.concatenate([c, np.zeros(pad, np.int32)])
-            x = np.concatenate([x, np.zeros(pad, np.int32)])
-            self._centers, self._contexts, self._buffered = [], [], 0
-            yield c, x, n
+            padded = [
+                np.concatenate([c, np.zeros((pad, *c.shape[1:]), c.dtype)])
+                for c in cats
+            ]
+            self._bufs = [[] for _ in range(self.num_streams)]
+            self._buffered = 0
+            yield (*padded, n)
 
 
 def epoch_batches(
@@ -180,7 +202,7 @@ def epoch_batches(
     *,
     pairs_per_batch: int,
     window: int,
-    subsample_ratio: float = 1e-6,
+    subsample_ratio: float = 0.0,
     seed: int = 0,
     iteration: int = 1,
     shard: int = 0,
@@ -222,3 +244,92 @@ def epoch_batches(
 
 def count_train_words(sentences: Sequence[np.ndarray]) -> int:
     return int(sum(int(s.shape[0]) for s in sentences))
+
+
+# ---------------------------------------------------------------------------------------
+# CBOW variant (BASELINE config 5): grouped context windows instead of flat pairs.
+# ---------------------------------------------------------------------------------------
+
+
+def dynamic_window_cbow(
+    sentence: np.ndarray,
+    window: int,
+    rng: np.random.Generator,
+    legacy_asymmetric_window: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-position padded context windows for CBOW.
+
+    Same window draw as :func:`dynamic_window_pairs` (so skip-gram and CBOW see identical
+    context structure), but grouped per center: returns (centers [L], contexts [L, C],
+    ctx_mask [L, C]) with C = 2·window. Positions with zero context are dropped.
+    """
+    L = sentence.shape[0]
+    C = 2 * window
+    if L == 0:
+        return (np.empty(0, np.int32), np.empty((0, C), np.int32),
+                np.empty((0, C), np.float32))
+    positions = np.arange(L, dtype=np.int64)
+    b = rng.integers(0, window, size=L)
+    left = np.minimum(b, positions)
+    right_extent = b if not legacy_asymmetric_window else b - 1
+    right = np.clip(np.minimum(right_extent, L - 1 - positions), 0, None)
+    total = left + right
+    num_pairs = int(total.sum())
+    contexts = np.zeros((L, C), dtype=np.int32)
+    ctx_mask = np.zeros((L, C), dtype=np.float32)
+    if num_pairs:
+        group_starts = np.cumsum(total) - total
+        offsets = np.arange(num_pairs, dtype=np.int64) - np.repeat(group_starts, total)
+        rows = np.repeat(positions, total)
+        left_rep = np.repeat(left, total)
+        ctx_pos = rows - left_rep + offsets + (offsets >= left_rep)
+        contexts[rows, offsets] = sentence[ctx_pos]
+        ctx_mask[rows, offsets] = 1.0
+    keep = total > 0
+    return (sentence[keep].astype(np.int32), contexts[keep], ctx_mask[keep])
+
+
+@dataclass
+class CbowBatch:
+    centers: np.ndarray    # int32 [B]
+    contexts: np.ndarray   # int32 [B, C]
+    ctx_mask: np.ndarray   # float32 [B, C]
+    mask: np.ndarray       # float32 [B]
+    words_seen: int
+    num_real: int
+
+
+def epoch_batches_cbow(
+    sentences: Sequence[np.ndarray],
+    vocab: Vocabulary,
+    *,
+    pairs_per_batch: int,
+    window: int,
+    subsample_ratio: float = 0.0,
+    seed: int = 0,
+    iteration: int = 1,
+    shard: int = 0,
+    num_shards: int = 1,
+    shuffle: bool = True,
+    legacy_asymmetric_window: bool = True,
+) -> Iterator[CbowBatch]:
+    """CBOW analog of :func:`epoch_batches`: fixed-shape [B, 2·window] context batches."""
+    B = int(pairs_per_batch)
+    rng = np.random.default_rng(
+        np.random.SeedSequence(entropy=seed, spawn_key=(iteration, shard)))
+    keep = keep_probabilities(vocab.counts, vocab.train_words_count, subsample_ratio)
+    order = np.arange(shard, len(sentences), num_shards)
+    if shuffle:
+        rng.shuffle(order)
+    batcher = PairBatcher(B, num_streams=3)
+    words_seen = 0
+    for si in order:
+        sub = subsample_sentence(sentences[si], keep, rng)
+        words_seen += int(sub.shape[0])
+        c, x, m = dynamic_window_cbow(sub, window, rng, legacy_asymmetric_window)
+        batcher.add(c, x, m)
+        for bc, bx, bm, n in batcher.drain():
+            yield CbowBatch(bc, bx, bm, np.ones(B, np.float32), words_seen, n)
+    for bc, bx, bm, n in batcher.drain(flush=True):
+        yield CbowBatch(bc, bx, bm, (np.arange(B) < n).astype(np.float32),
+                        words_seen, n)

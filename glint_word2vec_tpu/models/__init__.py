@@ -1,0 +1,13 @@
+from glint_word2vec_tpu.models.word2vec import Word2VecModel
+from glint_word2vec_tpu.models.estimator import Word2Vec
+from glint_word2vec_tpu.models.compat import (
+    ServerSideGlintWord2Vec,
+    ServerSideGlintWord2VecModel,
+)
+
+__all__ = [
+    "Word2VecModel",
+    "Word2Vec",
+    "ServerSideGlintWord2Vec",
+    "ServerSideGlintWord2VecModel",
+]

@@ -1,0 +1,251 @@
+"""Drop-in familiarity layer: the reference's class names, setters, defaults and model ops.
+
+Mirrors the builder surface of the MLlib estimator (mllib:92-244), the ML params
+(ml:40-222,234-282) and the PySpark binding (ml_glintword2vec.py:38-385) so a user of the
+reference can port call sites mechanically:
+
+    w2v = (ServerSideGlintWord2Vec()
+           .setVectorSize(100).setWindowSize(5).setNumIterations(3).setSeed(1))
+    model = w2v.fit(sentences)            # sentences: list of token lists
+    model.findSynonyms("wien", 10)
+    model.save(path); ServerSideGlintWord2VecModel.load(path)
+
+Differences, by design (each is the TPU replacing the PS/RPC machinery, not an omission):
+
+- ``setParameterServerHost``/``setParameterServerConfig`` (mllib:219-237) are accepted and
+  ignored with a warning: there are no parameter servers. Deployment mode A (in-app PS) ==
+  in-process mesh; mode B (separate PS cluster, README.md:45-57) == training on the pod +
+  serving queries from checkpoints.
+- ``setNumParameterServers`` maps to the mesh's model-axis size (embedding row shards).
+- the Akka payload constraint ``batchSize·n·window ≤ 10000`` (mllib:154-188) is validated
+  for familiarity but only warns: no RPC, no payload cap.
+- ``stop(terminateOtherClients)`` releases device buffers; the flag is accepted for
+  signature parity (cross-application PS termination has no analog).
+- input is plain Python sequences instead of RDD/DataFrame; ``setInputCol``/
+  ``setOutputCol`` exist for signature parity on dict-shaped rows.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.models.estimator import Word2Vec
+from glint_word2vec_tpu.models.word2vec import Word2VecModel
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+_MAX_MESSAGE_FLOATS = 10_000  # the reference's Akka budget (mllib:83-85) — advisory here
+
+
+class ServerSideGlintWord2Vec:
+    """Builder-style estimator with the reference's knob names and defaults
+    (mllib:67-81,251; ml setDefault block)."""
+
+    def __init__(self):
+        self._vector_size = 100
+        self._learning_rate = 0.01875
+        self._num_partitions = 1
+        self._num_iterations = 1
+        self._min_count = 5
+        self._max_sentence_length = 1000
+        self._window = 5
+        self._batch_size = 50
+        self._n = 5
+        self._subsample_ratio = 0.0  # reference default 1e-6 *behaves* as off (no-op bug)
+        self._num_parameter_servers = 5
+        self._parameter_server_host = ""
+        self._parameter_server_config: Dict = {}
+        self._unigram_table_size = 100_000_000
+        self._seed = 0
+        self._input_col = "sentence"
+        self._output_col = "vector"
+
+    # -- setters (names: mllib:92-244 and ml:234-282) ----------------------------------
+
+    def setVectorSize(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._vector_size = int(value)
+        return self
+
+    def setLearningRate(self, value: float) -> "ServerSideGlintWord2Vec":
+        self._learning_rate = float(value)
+        return self
+
+    setStepSize = setLearningRate  # ml naming (ml:246)
+
+    def setNumPartitions(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._num_partitions = int(value)
+        return self
+
+    def setNumIterations(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._num_iterations = int(value)
+        return self
+
+    setMaxIter = setNumIterations  # ml naming (ml:252)
+
+    def setSeed(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._seed = int(value)
+        return self
+
+    def setWindowSize(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._window = int(value)
+        self._check_payload_constraint()
+        return self
+
+    def setMinCount(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._min_count = int(value)
+        return self
+
+    def setMaxSentenceLength(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._max_sentence_length = int(value)
+        return self
+
+    def setBatchSize(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._batch_size = int(value)
+        self._check_payload_constraint()
+        return self
+
+    def setN(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._n = int(value)
+        self._check_payload_constraint()
+        return self
+
+    def setSubsampleRatio(self, value: float) -> "ServerSideGlintWord2Vec":
+        self._subsample_ratio = float(value)
+        return self
+
+    def setNumParameterServers(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._num_parameter_servers = int(value)
+        return self
+
+    def setParameterServerHost(self, value: str) -> "ServerSideGlintWord2Vec":
+        if value:
+            warnings.warn(
+                "parameterServerHost is ignored: there are no parameter servers on TPU "
+                "(the mesh is in-process)", stacklevel=2)
+        self._parameter_server_host = value
+        return self
+
+    def setParameterServerConfig(self, value: Dict) -> "ServerSideGlintWord2Vec":
+        if value:
+            warnings.warn(
+                "parameterServerConfig is ignored: there is no Akka transport to "
+                "configure", stacklevel=2)
+        self._parameter_server_config = dict(value)
+        return self
+
+    def setUnigramTableSize(self, value: int) -> "ServerSideGlintWord2Vec":
+        self._unigram_table_size = int(value)
+        return self
+
+    def setInputCol(self, value: str) -> "ServerSideGlintWord2Vec":
+        self._input_col = value
+        return self
+
+    def setOutputCol(self, value: str) -> "ServerSideGlintWord2Vec":
+        self._output_col = value
+        return self
+
+    def _check_payload_constraint(self) -> None:
+        # The reference *errors* here because Akka caps payloads (mllib:154-188); with no
+        # RPC the combination is legal, so parity stops at a warning.
+        if self._batch_size * self._n * self._window > _MAX_MESSAGE_FLOATS:
+            warnings.warn(
+                f"batchSize*n*window = {self._batch_size * self._n * self._window} "
+                f"> {_MAX_MESSAGE_FLOATS} would be rejected by the reference (Akka "
+                "payload cap); harmless here", stacklevel=3)
+
+    # -- fit ---------------------------------------------------------------------------
+
+    def to_config(self) -> Word2VecConfig:
+        n_shards = self._num_parameter_servers
+        import jax
+        n_dev = len(jax.devices())
+        return Word2VecConfig(
+            vector_size=self._vector_size,
+            learning_rate=self._learning_rate,
+            num_partitions=self._num_partitions,
+            num_iterations=self._num_iterations,
+            min_count=self._min_count,
+            max_sentence_length=self._max_sentence_length,
+            window=self._window,
+            batch_size=self._batch_size,
+            negatives=self._n,
+            subsample_ratio=self._subsample_ratio,
+            num_model_shards=min(n_shards, n_dev),
+            unigram_table_size=self._unigram_table_size,
+            seed=self._seed,
+        )
+
+    def fit(self, sentences: Iterable[Sequence[str]]) -> "ServerSideGlintWord2VecModel":
+        """sentences: iterable of token sequences, or dicts holding one under inputCol
+        (the DataFrame-column analog, ml:286)."""
+        sentences = [
+            s[self._input_col] if isinstance(s, dict) else s for s in sentences]
+        model = Word2Vec(self.to_config()).fit(sentences)
+        return ServerSideGlintWord2VecModel(model, self._input_col, self._output_col)
+
+
+class ServerSideGlintWord2VecModel:
+    """Model wrapper with the reference's op names (mllib:460-669, ml:322-497)."""
+
+    def __init__(self, model: Word2VecModel, input_col: str = "sentence",
+                 output_col: str = "vector"):
+        self._model = model
+        self._input_col = input_col
+        self._output_col = output_col
+
+    @property
+    def inner(self) -> Word2VecModel:
+        return self._model
+
+    def getVectors(self) -> Dict[str, np.ndarray]:
+        return self._model.get_vectors()
+
+    def transform(self, data):
+        """Word → vector (mllib:511-519) for a string; sentence-average vectors
+        (ml:432-460) for sequences/dicts of tokens."""
+        if isinstance(data, str):
+            return self._model.transform(data)
+        rows = list(data)
+        if rows and isinstance(rows[0], dict):
+            sents = [r[self._input_col] for r in rows]
+            vecs = self._model.transform_sentences(sents)
+            return [{**r, self._output_col: vecs[i]} for i, r in enumerate(rows)]
+        if rows and isinstance(rows[0], (list, tuple)):
+            return self._model.transform_sentences(rows)
+        # iterator-of-words path (mllib:529-546)
+        return list(self._model.transform_words(rows))
+
+    def findSynonyms(self, query, num: int) -> List[Tuple[str, float]]:
+        return self._model.find_synonyms(query, num)
+
+    findSynonymsArray = findSynonyms
+
+    def analogy(self, a: str, b: str, c: str, num: int = 10):
+        return self._model.analogy(a, b, c, num)
+
+    def toLocal(self) -> Tuple[List[str], np.ndarray]:
+        return self._model.to_local()
+
+    def save(self, path: str) -> None:
+        self._model.save(path)
+
+    @classmethod
+    def load(cls, path: str, parameterServerHost: str = "",
+             parameterServerConfig: Optional[Dict] = None
+             ) -> "ServerSideGlintWord2VecModel":
+        """Signature parity with the 3 load overloads (mllib:683-725, ml:573-599,
+        python ml_glintword2vec.py:353-373); the PS args are accepted and ignored."""
+        if parameterServerHost or parameterServerConfig:
+            warnings.warn("parameter-server arguments are ignored on load",
+                          stacklevel=2)
+        return cls(Word2VecModel.load(path))
+
+    def stop(self, terminateOtherClients: bool = False) -> None:
+        del terminateOtherClients  # signature parity (mllib:664-667)
+        self._model.stop()

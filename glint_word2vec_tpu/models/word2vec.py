@@ -1,0 +1,324 @@
+"""Word2Vec model — transform, sentence averaging, synonym/analogy search, persistence.
+
+The TPU-native model API with the capabilities of both reference model layers:
+
+- MLlib model (C8, mllib:460-669): ``transform`` (word → vector; batched iterator),
+  ``find_synonyms`` (word and vector overloads), ``get_vectors``, ``to_local``, ``save``,
+  ``stop``.
+- ML model (C12, ml:322-497): sentence ``transform`` = **average of in-vocab word
+  vectors** (ml:428-460, server-side pullAverage), ``find_synonyms_array``,
+  ``get_vectors`` as a streaming iterator.
+
+Where the reference pays an RPC per op (pull/pullAverage/norms/multiply with 1-5 min
+Await timeouts, mllib:486-652), every op here is a jitted gather/reduction on the sharded
+embedding array; ``find_synonyms``'s full-vocab matvec + top-k (mllib:583-630: client-side
+O(V) scan over a PS matvec) runs as one sharded ``cosine = (syn0 @ q) / ‖rows‖`` + top-k
+on device.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.vocab import Vocabulary
+from glint_word2vec_tpu.parallel.mesh import MeshPlan, make_mesh, pad_vocab_for_sharding
+from glint_word2vec_tpu.train import checkpoint as ckpt
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+
+class Word2VecModel:
+    """Trained word embeddings with the full reference model-op surface."""
+
+    def __init__(
+        self,
+        vocab: Vocabulary,
+        syn0: jax.Array,
+        syn1: Optional[jax.Array] = None,
+        config: Optional[Word2VecConfig] = None,
+        plan: Optional[MeshPlan] = None,
+        train_state: Optional["ckpt.TrainState"] = None,
+    ):
+        if syn0.shape[0] != vocab.size:
+            raise ValueError(
+                f"syn0 has {syn0.shape[0]} rows but vocabulary has {vocab.size} words")
+        self.vocab = vocab
+        self.config = config or Word2VecConfig(vector_size=int(syn0.shape[1]))
+        self.plan = plan
+        self.train_state = train_state
+        syn0 = jnp.asarray(syn0)
+        syn1 = jnp.asarray(syn1) if syn1 is not None else None
+        if plan is not None:
+            # Row-sharding needs rows % num_model == 0: pad with zero rows (zero norm →
+            # cosine 0 and explicitly masked out of top-k), the model-ops analog of the
+            # trainer's pad_vocab_for_sharding.
+            Vp = pad_vocab_for_sharding(vocab.size, plan.num_model)
+            pad = Vp - vocab.size
+            if pad:
+                zeros = jnp.zeros((pad, syn0.shape[1]), syn0.dtype)
+                syn0 = jnp.concatenate([syn0, zeros])
+                if syn1 is not None:
+                    syn1 = jnp.concatenate([syn1, zeros])
+            syn0 = jax.device_put(syn0, plan.embedding)
+            if syn1 is not None:
+                syn1 = jax.device_put(syn1, plan.embedding)
+        self._full0 = syn0
+        self._full1 = syn1
+        self._norms: Optional[jax.Array] = None
+        self._stopped = False
+
+    @property
+    def syn0(self) -> jax.Array:
+        """Input embeddings, unpadded view [vocab_size, D]."""
+        self._check_alive()
+        return self._full0[: self.vocab.size]
+
+    @property
+    def syn1(self) -> Optional[jax.Array]:
+        if self._full1 is None:
+            return None
+        self._check_alive()
+        return self._full1[: self.vocab.size]
+
+    # -- basic properties --------------------------------------------------------------
+
+    @property
+    def vector_size(self) -> int:
+        return int(self._full0.shape[1])
+
+    @property
+    def num_words(self) -> int:
+        return self.vocab.size
+
+    def _check_alive(self) -> None:
+        if self._stopped:
+            raise RuntimeError("model has been stopped; its buffers were released")
+
+    # -- transform (C8 mllib:511-546; C12 ml:432-460) ----------------------------------
+
+    def transform(self, word: str) -> np.ndarray:
+        """Vector of a single word. Raises on OOV like the reference (mllib:516-518)."""
+        self._check_alive()
+        idx = self.vocab.get(word)
+        if idx < 0:
+            raise KeyError(f"{word} not in vocabulary")
+        return np.asarray(self.syn0[idx])
+
+    def transform_words(self, words: Iterable[str], batch_size: int = 10_000
+                        ) -> Iterator[np.ndarray]:
+        """Batched word → vector stream (the reference's 10k-word batched iterator path,
+        mllib:529-546, noted there as the efficient variant)."""
+        self._check_alive()
+        buf: List[str] = []
+
+        def emit(buf: List[str]) -> Iterator[np.ndarray]:
+            idxs = []
+            for w in buf:
+                i = self.vocab.get(w)
+                if i < 0:
+                    raise KeyError(f"{w} not in vocabulary")
+                idxs.append(i)
+            rows = np.asarray(self.syn0[jnp.asarray(idxs, jnp.int32)])
+            yield from rows
+
+        for w in words:
+            buf.append(w)
+            if len(buf) >= batch_size:
+                yield from emit(buf)
+                buf = []
+        if buf:
+            yield from emit(buf)
+
+    def transform_sentences(
+        self, sentences: Sequence[Sequence[str]], batch_size: int = 10_000
+    ) -> np.ndarray:
+        """Sentence → mean of in-vocab word vectors (the ML transform semantics,
+        ml:428-460). OOV words are silently dropped (ml:451-452); a sentence with no
+        in-vocab words maps to the zero vector. Processed in fixed-size row batches like
+        the reference's 10k-row mapPartitions slides (ml:449-450)."""
+        self._check_alive()
+        out = np.zeros((len(sentences), self.vector_size), dtype=np.float32)
+        flat: List[int] = []
+        seg: List[int] = []
+        row = 0
+        rows_in_batch: List[int] = []
+
+        def flush():
+            nonlocal flat, seg, rows_in_batch
+            if not rows_in_batch:
+                return
+            if flat:
+                idx = jnp.asarray(flat, jnp.int32)
+                seg_ids = jnp.asarray(seg, jnp.int32)
+                sums = jax.ops.segment_sum(
+                    self.syn0[idx], seg_ids, num_segments=len(rows_in_batch))
+                counts = jax.ops.segment_sum(
+                    jnp.ones(len(flat), jnp.float32), seg_ids,
+                    num_segments=len(rows_in_batch))
+                means = np.asarray(sums / jnp.maximum(counts, 1.0)[:, None])
+                for local, global_row in enumerate(rows_in_batch):
+                    out[global_row] = means[local]
+            flat, seg, rows_in_batch = [], [], []
+
+        for sent in sentences:
+            local = len(rows_in_batch)
+            rows_in_batch.append(row)
+            for w in sent:
+                i = self.vocab.get(w)
+                if i >= 0:
+                    flat.append(i)
+                    seg.append(local)
+            row += 1
+            if len(rows_in_batch) >= batch_size:
+                flush()
+        flush()
+        return out
+
+    # -- pull / norms / multiply (G5, mllib:486,514,598) -------------------------------
+
+    def pull(self, indices: Sequence[int]) -> np.ndarray:
+        """Row gather — the PS ``pull`` (mllib:514,539)."""
+        self._check_alive()
+        return np.asarray(self.syn0[jnp.asarray(indices, jnp.int32)])
+
+    @property
+    def norms(self) -> jax.Array:
+        """Per-row Euclidean norms, computed once and cached (mllib:486,600-609)."""
+        self._check_alive()
+        if self._norms is None:
+            self._norms = jnp.linalg.norm(self._full0, axis=1)
+        return self._norms[: self.vocab.size]
+
+    def multiply(self, vector: np.ndarray) -> np.ndarray:
+        """Full matrix–vector product syn0 @ v (the PS ``multiply`` powering cosine
+        search, mllib:598). One sharded matvec on device."""
+        self._check_alive()
+        v = jnp.asarray(vector, jnp.float32)
+        return np.asarray(self.syn0 @ v)
+
+    # -- synonym / analogy search (C8 mllib:554-630, C12 ml:375-420) -------------------
+
+    def find_synonyms(
+        self, query: Union[str, np.ndarray], num: int
+    ) -> List[Tuple[str, float]]:
+        """Top-``num`` cosine-similar words. String query excludes the query word itself
+        (mllib:621-629); vector queries (for analogies) do not."""
+        self._check_alive()
+        if isinstance(query, str):
+            word: Optional[str] = query
+            vec = jnp.asarray(self.transform(query))
+        else:
+            word = None
+            vec = jnp.asarray(query, jnp.float32)
+        k = num + (1 if word is not None else 0)
+        k = min(k, self.num_words)
+        self.norms  # materialize the cached full-row norms
+        scores, idxs = _cosine_topk(self._full0, self._norms, vec, k, self.num_words)
+        out: List[Tuple[str, float]] = []
+        for i, s in zip(np.asarray(idxs), np.asarray(scores)):
+            w = self.vocab.words[int(i)]
+            if w == word:
+                continue
+            out.append((w, float(s)))
+        return out[:num]
+
+    find_synonyms_array = find_synonyms  # ml:405-420 naming alias
+
+    def analogy(self, a: str, b: str, c: str, num: int = 10) -> List[Tuple[str, float]]:
+        """b − a + c vector arithmetic, excluding the three query words — the analogy
+        pattern from the reference's integration gates (it spec:327-352)."""
+        va, vb, vc = self.transform(a), self.transform(b), self.transform(c)
+        res = self.find_synonyms(vb - va + vc, num + 3)
+        return [(w, s) for w, s in res if w not in (a, b, c)][:num]
+
+    # -- exports (C8 mllib:638-662) ----------------------------------------------------
+
+    def get_vectors(self) -> Dict[str, np.ndarray]:
+        """word → vector for the whole vocabulary (mllib:638-649; mind the reference's
+        caveat that this pulls everything to the client, mllib:635-637)."""
+        self._check_alive()
+        mat = np.asarray(self.syn0)
+        return {w: mat[i] for i, w in enumerate(self.vocab.words)}
+
+    def iter_vectors(self, batch_size: int = 10_000
+                     ) -> Iterator[Tuple[str, np.ndarray]]:
+        """Streaming variant of get_vectors — the analog of the ML layer's distributed
+        per-partition pulls (ml:342-364) for vocabularies too large for one dict."""
+        self._check_alive()
+        for start in range(0, self.num_words, batch_size):
+            stop = min(start + batch_size, self.num_words)
+            block = np.asarray(self.syn0[start:stop])
+            for i in range(stop - start):
+                yield self.vocab.words[start + i], block[i]
+
+    def to_local(self) -> Tuple[List[str], np.ndarray]:
+        """Dense host-side export (words, matrix) — the ``toLocal`` analog
+        (mllib:651-662) without the Spark model wrapper."""
+        self._check_alive()
+        return list(self.vocab.words), np.asarray(self.syn0)
+
+    # -- persistence (G9/C13) ----------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        self._check_alive()
+        ckpt.save_model(
+            path, self.vocab.words, self.vocab.counts,
+            np.asarray(self.syn0),
+            np.asarray(self.syn1) if self.syn1 is not None else None,
+            self.config, self.train_state)
+
+    @classmethod
+    def load(cls, path: str, plan: Optional[MeshPlan] = None) -> "Word2VecModel":
+        """Load a saved model; ``plan`` retargets the arrays onto a different mesh — the
+        analog of the reference's load-onto-different-PS-topology overloads
+        (mllib:696-725, ml:584-599)."""
+        data = ckpt.load_model(path)
+        vocab = Vocabulary.from_words_and_counts(data["words"], data["counts"])
+        return cls(
+            vocab=vocab,
+            syn0=jnp.asarray(data["syn0"]),
+            syn1=jnp.asarray(data["syn1"]) if data["syn1"] is not None else None,
+            config=data["config"],
+            plan=plan,
+            train_state=data["train_state"],
+        )
+
+    def stop(self) -> None:
+        """Release device buffers — the analog of the reference's PS teardown
+        (client.terminateOnSpark + matrix.destroy, mllib:655-667). Idempotent."""
+        if self._stopped:
+            return
+        for arr in (self._full0, self._full1, self._norms):
+            if arr is not None:
+                try:
+                    arr.delete()
+                except Exception:
+                    pass
+        self._full0 = None  # type: ignore[assignment]
+        self._full1 = None
+        self._norms = None
+        self._stopped = True
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("k", "valid_rows"))
+def _cosine_topk(syn0: jax.Array, norms: jax.Array, query: jax.Array, k: int,
+                 valid_rows: int) -> Tuple[jax.Array, jax.Array]:
+    """cosine(rows, q) top-k: normalize query (snrm2/sscal analog, mllib:589-596),
+    sharded matvec (mllib:598), divide by row norms with zero-norm → 0 (mllib:601-609),
+    device top-k instead of the client-side BoundedPriorityQueue scan (mllib:611-619).
+    Rows past valid_rows are sharding padding, excluded outright."""
+    qn = jnp.linalg.norm(query)
+    q = query / jnp.maximum(qn, 1e-12)
+    dots = syn0 @ q
+    cos = jnp.where(norms > 0, dots / jnp.maximum(norms, 1e-12), 0.0)
+    cos = jnp.where(jnp.arange(cos.shape[0]) < valid_rows, cos, -jnp.inf)
+    return jax.lax.top_k(cos, k)

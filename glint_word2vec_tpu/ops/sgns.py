@@ -119,15 +119,26 @@ def sgns_step(
     num_negatives: int,
     sigmoid_mode: str = "exact",
     compute_dtype: jnp.dtype = jnp.float32,
+    duplicate_scaling: bool = False,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """One synchronous SGNS update on a fixed-shape batch of (center, context) pairs.
 
     Negatives equal to their pair's positive context word are skipped (zero gradient), the
     classic word2vec rule the fork's server-side sampler follows. Padded pairs (mask 0)
     contribute nothing: their coefficients are multiplied by the mask before scatter.
+
+    ``duplicate_scaling``: divide each row's accumulated update by the number of times the
+    row occurs in the batch. The reference never faces this — its async 50-pair minibatches
+    apply sequentially (mllib:417-429), so a frequent word's updates interleave; in one
+    large synchronous batch they *sum*, and at extreme duplicate density (tiny vocab ×
+    large batch) the effective per-row step is duplicates × α, which can diverge. Scaling
+    makes each row take the *mean* of its pair updates — stable at any batch size, at the
+    cost of slower differentiation (frequent rows see one averaged step per batch). Default
+    off: textbook accumulate semantics, the reference's math.
     """
     syn0, syn1 = params
     B = centers.shape[0]
+    V = syn0.shape[0]
     negatives = sample_negatives(table, key, (B, num_negatives))
     neg_valid = (negatives != contexts[:, None]).astype(jnp.float32) * mask[:, None]
 
@@ -143,11 +154,22 @@ def sgns_step(
     g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask               # [B]
     g_neg = (0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid          # [B, n]
 
-    gp = g_pos[:, None].astype(compute_dtype)
-    gn = g_neg[..., None].astype(compute_dtype)
-    d_in = gp * e_pos + jnp.einsum("bn,bnd->bd", g_neg.astype(compute_dtype), e_neg)
-    d_pos = gp * e_in                                   # [B, D]
-    d_neg = gn * e_in[:, None, :]                       # [B, n, D]
+    if duplicate_scaling:
+        cnt0 = jnp.zeros(V, jnp.float32).at[centers].add(mask)
+        cnt1 = (jnp.zeros(V, jnp.float32).at[contexts].add(mask)
+                .at[negatives.reshape(-1)].add(neg_valid.reshape(-1)))
+        g_pos_in = g_pos / jnp.maximum(cnt0[centers], 1.0)
+        g_neg_in = g_neg / jnp.maximum(cnt0[centers], 1.0)[:, None]
+        g_pos_out = g_pos / jnp.maximum(cnt1[contexts], 1.0)
+        g_neg_out = g_neg / jnp.maximum(cnt1[negatives], 1.0)
+    else:
+        g_pos_in = g_pos_out = g_pos
+        g_neg_in = g_neg_out = g_neg
+
+    d_in = (g_pos_in[:, None].astype(compute_dtype) * e_pos
+            + jnp.einsum("bn,bnd->bd", g_neg_in.astype(compute_dtype), e_neg))
+    d_pos = g_pos_out[:, None].astype(compute_dtype) * e_in          # [B, D]
+    d_neg = g_neg_out[..., None].astype(compute_dtype) * e_in[:, None, :]  # [B, n, D]
 
     dtype = syn0.dtype
     new_syn0 = syn0.at[centers].add(d_in.astype(dtype))
@@ -179,6 +201,7 @@ def cbow_step(
     num_negatives: int,
     sigmoid_mode: str = "exact",
     compute_dtype: jnp.dtype = jnp.float32,
+    duplicate_scaling: bool = False,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """CBOW variant (BASELINE config 5): input = mean of context vectors, output = center.
 
@@ -205,12 +228,27 @@ def cbow_step(
     g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask * has_ctx
     g_neg = (0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid * has_ctx[:, None]
 
+    V = syn0.shape[0]
+    live_ctx = ctx_mask * (mask * has_ctx)[:, None]
+    if duplicate_scaling:
+        cnt0 = jnp.zeros(V, jnp.float32).at[contexts.reshape(-1)].add(
+            live_ctx.reshape(-1))
+        cnt1 = (jnp.zeros(V, jnp.float32).at[centers].add(mask * has_ctx)
+                .at[negatives.reshape(-1)].add(
+                    (neg_valid * has_ctx[:, None]).reshape(-1)))
+        ctx_scale = (1.0 / jnp.maximum(cnt0[contexts], 1.0)).astype(compute_dtype)
+        g_pos_out = g_pos / jnp.maximum(cnt1[centers], 1.0)
+        g_neg_out = g_neg / jnp.maximum(cnt1[negatives], 1.0)
+    else:
+        ctx_scale = jnp.ones_like(contexts, compute_dtype)
+        g_pos_out, g_neg_out = g_pos, g_neg
+
     gp = g_pos[:, None].astype(compute_dtype)
     d_hidden = gp * e_out + jnp.einsum("bn,bnd->bd", g_neg.astype(compute_dtype), e_neg)
     # mean convention: each context word gets d_hidden / |context|
-    d_ctx = (d_hidden / ctx_n[:, None])[:, None, :] * ctx_m           # [B, C, D]
-    d_out = gp * hidden
-    d_neg = g_neg[..., None].astype(compute_dtype) * hidden[:, None, :]
+    d_ctx = (d_hidden / ctx_n[:, None])[:, None, :] * ctx_m * ctx_scale[..., None]
+    d_out = g_pos_out[:, None].astype(compute_dtype) * hidden
+    d_neg = g_neg_out[..., None].astype(compute_dtype) * hidden[:, None, :]
 
     dtype = syn0.dtype
     D = syn0.shape[1]

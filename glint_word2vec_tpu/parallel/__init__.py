@@ -1,0 +1,21 @@
+from glint_word2vec_tpu.parallel.mesh import (
+    MeshPlan,
+    make_mesh,
+    embedding_sharding,
+    batch_sharding,
+    replicated_sharding,
+    shard_params,
+    shard_batch,
+    pad_vocab_for_sharding,
+)
+
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "embedding_sharding",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_params",
+    "shard_batch",
+    "pad_vocab_for_sharding",
+]

@@ -1,0 +1,4 @@
+from glint_word2vec_tpu.train.checkpoint import TrainState, load_model, save_model
+from glint_word2vec_tpu.train.trainer import HeartbeatRecord, Trainer
+
+__all__ = ["TrainState", "load_model", "save_model", "HeartbeatRecord", "Trainer"]

@@ -1,0 +1,139 @@
+"""Model persistence (reference components G9/C13) with mid-training checkpointing.
+
+On-disk layout keeps the reference's composite-model contract (mllib:493-498,714-715,
+ml:504-507) while replacing HDFS matrix shards with array files:
+
+    path/
+      words          one word per line, line order == embedding row order (exact parity
+                     with the reference's sidecar, mllib:495-496)
+      counts.npy     per-word corpus counts (needed to rebuild the negative-sampling
+                     table on resume; the reference re-broadcasts vocabCns instead)
+      syn0.npy       input embeddings [V, D] float32
+      syn1.npy       output embeddings [V, D] float32 (present iff trainable state saved;
+                     the reference's save keeps both matrices alive on the PS too)
+      metadata.json  config + format version + train_state — the analog of the ML layer's
+                     DefaultParamsWriter metadata (ml:504-507)
+
+Improvement over the reference: ``train_state`` records (iteration, words_processed), so a
+``numIterations`` run is resumable mid-way — the reference is all-or-nothing (SURVEY §5).
+
+Arrays are gathered to host before writing; a tensorstore/orbax sharded writer can slot in
+behind the same layout for >HBM models.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from glint_word2vec_tpu.config import Word2VecConfig
+
+FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Mid-training progress: which iteration we are in and how many (subsampled) words
+    the lr-decay clock has consumed (mllib:405-413 semantics)."""
+
+    iteration: int = 1
+    words_processed: int = 0
+    finished: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrainState":
+        return cls(**{k: d[k] for k in ("iteration", "words_processed", "finished")
+                      if k in d})
+
+
+def save_model(
+    path: str,
+    words: List[str],
+    counts: np.ndarray,
+    syn0: np.ndarray,
+    syn1: Optional[np.ndarray],
+    config: Word2VecConfig,
+    train_state: Optional[TrainState] = None,
+) -> None:
+    """Atomic save: everything is written to a sibling temp directory first and swapped
+    into place, so a crash mid-save never corrupts an existing checkpoint (the whole point
+    of ``checkpoint_every_steps``-style periodic saves)."""
+    bad = [w for w in words if (not w) or ("\n" in w)]
+    if bad:
+        raise ValueError(
+            f"cannot save vocabulary: {len(bad)} token(s) are empty or contain newlines "
+            f"(first: {bad[0]!r}); the words sidecar is newline-delimited")
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent, f".{os.path.basename(path)}.tmp-{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        with open(os.path.join(tmp, "words"), "w", encoding="utf-8") as f:
+            for w in words:
+                f.write(w + "\n")
+        np.save(os.path.join(tmp, "counts.npy"), np.asarray(counts, dtype=np.int64))
+        syn0 = np.asarray(syn0, dtype=np.float32)
+        np.save(os.path.join(tmp, "syn0.npy"), syn0)
+        if syn1 is not None:
+            np.save(os.path.join(tmp, "syn1.npy"), np.asarray(syn1, dtype=np.float32))
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "framework": "glint_word2vec_tpu",
+            "vocab_size": int(syn0.shape[0]),
+            "vector_size": int(syn0.shape[1]),
+            "config": config.to_dict(),
+            "train_state": (train_state or TrainState(finished=True)).to_dict(),
+        }
+        with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
+            json.dump(meta, f, indent=2)
+        old = None
+        if os.path.exists(path):
+            old = path + f".old-{os.getpid()}"
+            os.rename(path, old)
+        os.rename(tmp, path)
+        if old is not None:
+            shutil.rmtree(old)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_model(path: str) -> Dict[str, Any]:
+    """Read a saved model directory. Returns dict with words, counts, syn0, syn1 (may be
+    None), config, train_state. Mirrors the reference's load contract (mllib:710-725:
+    read /words in row order, load matrix shards, rebuild model)."""
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no metadata.json under {path!r}")
+    with open(meta_path, "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    version = meta.get("format_version")
+    if version != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint format_version {version}")
+    with open(os.path.join(path, "words"), "r", encoding="utf-8") as f:
+        words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+    counts = np.load(os.path.join(path, "counts.npy"))
+    syn0 = np.load(os.path.join(path, "syn0.npy"))
+    syn1_path = os.path.join(path, "syn1.npy")
+    syn1 = np.load(syn1_path) if os.path.exists(syn1_path) else None
+    if syn0.shape[0] != len(words):
+        raise ValueError(
+            f"words sidecar has {len(words)} entries but syn0 has {syn0.shape[0]} rows")
+    return {
+        "words": words,
+        "counts": counts,
+        "syn0": syn0,
+        "syn1": syn1,
+        "config": Word2VecConfig.from_dict(meta["config"]),
+        "train_state": TrainState.from_dict(meta.get("train_state", {})),
+    }

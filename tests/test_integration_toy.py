@@ -1,0 +1,130 @@
+"""Integration suite on the reference's toy corpus with its semantic quality gates.
+
+The analog of the reference's only test suite (ServerSideGlintWord2VecSpec, SURVEY §4):
+train once on the German-Wikipedia country/capital corpus, then assert the same gates —
+top-10("österreich") contains "wien" with cosine > 0.9 (it spec:290-305) and the
+wien − österreich + deutschland ≈ berlin analogy with cosine > 0.9 (it spec:327-352) —
+plus transform/getVectors/persistence scenarios (it spec:137-415).
+
+Where the reference needed a Docker Spark+HDFS cluster and a detached PS app
+(build.sbt:48-77), this runs in-process: the corpus is read straight from the read-only
+reference checkout, and the "cluster" is the virtual device mesh from conftest.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu import (
+    ServerSideGlintWord2Vec,
+    ServerSideGlintWord2VecModel,
+    Word2Vec,
+)
+from glint_word2vec_tpu.data.vocab import read_corpus
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists("/root/reference/de_wikipedia_articles_country_capitals.txt"),
+    reason="reference toy corpus not available")
+
+# Hyperparameters mirror the reference's training test (it spec:83-106: seed 1,
+# stepSize 0.025, defaults elsewhere) with the TPU-native batching knobs; subsampling is
+# on (the reference's is a silent no-op — see pipeline.py) and 4 iterations substitute
+# for the extra effective updates its async 50-pair minibatches got from one pass.
+FIT = dict(vector_size=100, learning_rate=0.025, window=5, negatives=5, min_count=5,
+           pairs_per_batch=256, seed=1, subsample_ratio=3e-3, num_iterations=4)
+
+
+@pytest.fixture(scope="module")
+def corpus(toy_corpus_path):
+    sents = list(read_corpus(toy_corpus_path))
+    assert len(sents) > 3000
+    return sents
+
+
+@pytest.fixture(scope="module")
+def model(corpus):
+    return Word2Vec(**FIT).fit(corpus)
+
+
+def test_corpus_stats(corpus, model):
+    # vocab 3,609–3,611 at minCount 5 (it spec:22-37 reports 3,611 incl. tokenizer diffs)
+    assert sum(len(s) for s in corpus) == 161_676
+    assert abs(model.num_words - 3611) < 10
+    assert model.vector_size == 100
+
+
+def test_synonym_gate(model):
+    """top-10("österreich") contains "wien", cosine > 0.9 (it spec:290-305)."""
+    syns = model.find_synonyms("österreich", 10)
+    assert len(syns) == 10
+    d = dict(syns)
+    assert "wien" in d
+    assert d["wien"] > 0.9
+
+
+def test_analogy_gate(model):
+    """wien − österreich + deutschland ≈ berlin, cosine > 0.9 (it spec:327-352).
+
+    Built exactly as the reference does: sentence-transform each single-word sentence,
+    then vector arithmetic and a top-10 vector query."""
+    vecs = model.transform_sentences([["österreich"], ["deutschland"],
+                                      ["wien"], ["berlin"]])
+    analogy_vec = vecs[2] - vecs[0] + vecs[1]
+    res = model.find_synonyms(analogy_vec, 10)
+    assert len(res) == 10
+    d = dict(res)
+    assert "berlin" in d
+    assert d["berlin"] > 0.9
+
+
+def test_transform_single_words(model):
+    """Per-word vectors: nonzero, right length (it spec:198-238)."""
+    for w in ["österreich", "wien", "deutschland", "berlin"]:
+        v = model.transform(w)
+        assert v.shape == (100,)
+        assert np.abs(v).sum() > 0
+
+
+def test_transform_batched_iterator(model):
+    """Batched iterator path (it spec:240-258)."""
+    out = list(model.transform_words(["wien", "berlin", "paris"]))
+    assert len(out) == 3
+    assert all(v.shape == (100,) for v in out)
+
+
+def test_sentence_transform_preserves_columns(model):
+    """DataFrame-transform analog keeps extra columns + appends output (it spec:260-288)."""
+    wrapped = ServerSideGlintWord2VecModel(model)
+    rows = [{"sentence": ["wien", "ist"], "extra": 1}]
+    out = wrapped.transform(rows)
+    assert set(out[0]) == {"sentence", "extra", "vector"}
+    assert out[0]["extra"] == 1
+    assert out[0]["vector"].shape == (100,)
+
+
+def test_get_vectors_count(model):
+    """getVectors: one row per vocab word (it spec:384-398)."""
+    vecs = model.get_vectors()
+    assert len(vecs) == model.num_words
+    assert vecs["wien"].shape == (100,)
+
+
+def test_save_load_roundtrip_preserves_gates(model, tmp_path):
+    """Persistence round-trip (it spec:137-155): params and vectors survive."""
+    path = str(tmp_path / "toy-model")
+    model.save(path)
+    loaded = ServerSideGlintWord2VecModel.load(path)
+    d = dict(loaded.findSynonyms("österreich", 10))
+    assert "wien" in d and d["wien"] > 0.9
+    cfg = loaded.inner.config
+    assert cfg.seed == 1 and cfg.vector_size == 100
+    np.testing.assert_allclose(
+        loaded.inner.transform("wien"), model.transform("wien"), rtol=1e-6)
+
+
+def test_to_local(model):
+    """toLocal dense export (it spec:400-415)."""
+    words, mat = model.to_local()
+    assert mat.shape == (model.num_words, 100)
+    assert "wien" in words

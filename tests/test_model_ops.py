@@ -1,0 +1,136 @@
+"""Tests for model ops (C8/C12 analogs): transform, sentence averaging, synonyms,
+analogy, norms, multiply, exports, stop."""
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.vocab import Vocabulary
+from glint_word2vec_tpu.models.word2vec import Word2VecModel
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon"]
+
+
+@pytest.fixture(scope="module")
+def model():
+    rng = np.random.default_rng(0)
+    vocab = Vocabulary.from_words_and_counts(WORDS, [50, 40, 30, 20, 10])
+    syn0 = rng.normal(size=(5, 8)).astype(np.float32)
+    # make beta nearly parallel to alpha so synonyms are predictable
+    syn0[1] = syn0[0] * 2.0 + rng.normal(size=8).astype(np.float32) * 1e-3
+    return Word2VecModel(vocab, syn0, syn1=np.zeros_like(syn0),
+                         config=Word2VecConfig(vector_size=8)), syn0
+
+
+def test_transform_word(model):
+    m, syn0 = model
+    np.testing.assert_allclose(m.transform("alpha"), syn0[0], rtol=1e-6)
+    with pytest.raises(KeyError, match="not in vocabulary"):
+        m.transform("zzz")
+
+
+def test_transform_words_batched(model):
+    m, syn0 = model
+    out = list(m.transform_words(["gamma", "alpha", "gamma"], batch_size=2))
+    np.testing.assert_allclose(out[0], syn0[2], rtol=1e-6)
+    np.testing.assert_allclose(out[1], syn0[0], rtol=1e-6)
+    np.testing.assert_allclose(out[2], syn0[2], rtol=1e-6)
+    with pytest.raises(KeyError):
+        list(m.transform_words(["alpha", "zzz"]))
+
+
+def test_transform_sentences_average_and_oov(model):
+    m, syn0 = model
+    out = m.transform_sentences([
+        ["alpha", "beta"],          # mean of two vectors
+        ["alpha", "zzz", "alpha"],  # OOV dropped, duplicates count (ml:451-452)
+        ["zzz"],                    # no in-vocab words → zero vector
+        [],
+    ])
+    np.testing.assert_allclose(out[0], (syn0[0] + syn0[1]) / 2, rtol=1e-5)
+    np.testing.assert_allclose(out[1], syn0[0], rtol=1e-5)
+    np.testing.assert_array_equal(out[2], np.zeros(8))
+    np.testing.assert_array_equal(out[3], np.zeros(8))
+
+
+def test_transform_sentences_batch_boundary(model):
+    m, syn0 = model
+    sents = [["alpha"]] * 7
+    out = m.transform_sentences(sents, batch_size=3)  # 3+3+1 flushes
+    for row in out:
+        np.testing.assert_allclose(row, syn0[0], rtol=1e-5)
+
+
+def test_pull_and_multiply(model):
+    m, syn0 = model
+    np.testing.assert_allclose(m.pull([2, 0]), syn0[[2, 0]], rtol=1e-6)
+    v = np.arange(8, dtype=np.float32)
+    np.testing.assert_allclose(m.multiply(v), syn0 @ v, rtol=1e-4)
+
+
+def test_norms(model):
+    m, syn0 = model
+    np.testing.assert_allclose(
+        np.asarray(m.norms), np.linalg.norm(syn0, axis=1), rtol=1e-5)
+
+
+def test_find_synonyms_word_query_excludes_self(model):
+    m, _ = model
+    res = m.find_synonyms("alpha", 2)
+    words = [w for w, _ in res]
+    assert "alpha" not in words
+    assert words[0] == "beta"          # nearly parallel by construction
+    assert res[0][1] > 0.999
+    # scores sorted descending
+    assert res[0][1] >= res[1][1]
+
+
+def test_find_synonyms_vector_query(model):
+    m, syn0 = model
+    res = m.find_synonyms(syn0[0], 1)
+    assert res[0][0] in ("alpha", "beta")  # self allowed for vector queries (mllib:621)
+
+
+def test_find_synonyms_num_larger_than_vocab(model):
+    m, _ = model
+    res = m.find_synonyms("alpha", 50)
+    assert len(res) == 4  # vocab minus query word
+
+
+def test_analogy_excludes_queries(model):
+    m, _ = model
+    res = m.analogy("alpha", "beta", "gamma", num=2)
+    for w, _ in res:
+        assert w not in ("alpha", "beta", "gamma")
+
+
+def test_get_vectors_and_iter(model):
+    m, syn0 = model
+    vecs = m.get_vectors()
+    assert set(vecs) == set(WORDS)
+    np.testing.assert_allclose(vecs["delta"], syn0[3], rtol=1e-6)
+    streamed = dict(m.iter_vectors(batch_size=2))
+    for w in WORDS:
+        np.testing.assert_allclose(streamed[w], vecs[w], rtol=1e-6)
+
+
+def test_to_local(model):
+    m, syn0 = model
+    words, mat = m.to_local()
+    assert words == WORDS
+    np.testing.assert_allclose(mat, syn0, rtol=1e-6)
+
+
+def test_vocab_size_mismatch_raises():
+    vocab = Vocabulary.from_words_and_counts(["a"], [1])
+    with pytest.raises(ValueError, match="rows"):
+        Word2VecModel(vocab, np.zeros((2, 4), np.float32))
+
+
+def test_stop_releases():
+    vocab = Vocabulary.from_words_and_counts(["a", "b"], [2, 1])
+    m = Word2VecModel(vocab, np.zeros((2, 4), np.float32))
+    m.stop()
+    m.stop()  # idempotent
+    with pytest.raises(RuntimeError, match="stopped"):
+        m.transform("a")

@@ -1,0 +1,84 @@
+"""Persistence round-trip tests (G9/C13 analogs): words sidecar order, metadata,
+mid-training state, load-time errors."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.vocab import Vocabulary
+from glint_word2vec_tpu.models.word2vec import Word2VecModel
+from glint_word2vec_tpu.train.checkpoint import TrainState, load_model, save_model
+
+
+@pytest.fixture
+def saved(tmp_path):
+    words = ["w0", "w1", "w2"]
+    counts = np.array([30, 20, 10])
+    syn0 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    syn1 = -syn0
+    cfg = Word2VecConfig(vector_size=4, seed=7)
+    path = str(tmp_path / "model")
+    save_model(path, words, counts, syn0, syn1, cfg,
+               TrainState(iteration=2, words_processed=123, finished=False))
+    return path, words, counts, syn0, syn1, cfg
+
+
+def test_words_sidecar_format(saved):
+    """One word per line, line order == row order — exact parity with the reference's
+    sidecar (mllib:495-496,714-715)."""
+    path, words, *_ = saved
+    with open(os.path.join(path, "words")) as f:
+        assert f.read() == "w0\nw1\nw2\n"
+
+
+def test_roundtrip(saved):
+    path, words, counts, syn0, syn1, cfg = saved
+    data = load_model(path)
+    assert data["words"] == words
+    np.testing.assert_array_equal(data["counts"], counts)
+    np.testing.assert_array_equal(data["syn0"], syn0)
+    np.testing.assert_array_equal(data["syn1"], syn1)
+    assert data["config"].vector_size == 4 and data["config"].seed == 7
+    st = data["train_state"]
+    assert (st.iteration, st.words_processed, st.finished) == (2, 123, False)
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    vocab = Vocabulary.from_words_and_counts(["a", "b"], [5, 3])
+    syn0 = np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32)
+    m = Word2VecModel(vocab, syn0, config=Word2VecConfig(vector_size=4))
+    path = str(tmp_path / "m")
+    m.save(path)
+    m2 = Word2VecModel.load(path)
+    np.testing.assert_allclose(m2.transform("a"), syn0[0], rtol=1e-6)
+    assert m2.vocab.words == ["a", "b"]
+    assert m2.syn1 is None  # not saved when absent
+    assert m2.train_state.finished
+
+
+def test_load_missing_metadata(tmp_path):
+    with pytest.raises(FileNotFoundError, match="metadata.json"):
+        load_model(str(tmp_path / "nope"))
+
+
+def test_load_bad_version(saved):
+    path = saved[0]
+    meta_file = os.path.join(path, "metadata.json")
+    with open(meta_file) as f:
+        meta = json.load(f)
+    meta["format_version"] = 99
+    with open(meta_file, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match="format_version"):
+        load_model(path)
+
+
+def test_load_words_matrix_mismatch(saved):
+    path = saved[0]
+    with open(os.path.join(path, "words"), "a") as f:
+        f.write("extra\n")
+    with pytest.raises(ValueError, match="sidecar"):
+        load_model(path)

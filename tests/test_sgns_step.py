@@ -46,7 +46,8 @@ def test_manual_step_matches_autodiff_sgd(setup):
     alpha = 0.05
     step_key = jax.random.key(42)
     new_params, metrics = sgns_step(
-        params, centers, contexts, mask, step_key, alpha, table, N)
+        params, centers, contexts, mask, step_key, alpha, table, N,
+        duplicate_scaling=False)
 
     negatives = sample_negatives(table, step_key, (B, N))
     denom = jnp.maximum(mask.sum(), 1.0)
@@ -93,7 +94,8 @@ def test_duplicate_indices_accumulate(setup):
     contexts = jnp.ones(B, jnp.int32)
     mask = jnp.ones(B, jnp.float32)
     new_params, _ = sgns_step(
-        params, centers, contexts, mask, jax.random.key(5), 0.05, table, N)
+        params, centers, contexts, mask, jax.random.key(5), 0.05, table, N,
+        duplicate_scaling=False)
     # update to row 0 must equal B times the single-pair update (same context, same e values
     # pre-update, negatives differ per slot — so compare against per-slot sum via autodiff)
     negatives = sample_negatives(table, jax.random.key(5), (B, N))
@@ -218,3 +220,24 @@ def test_init_embeddings_ranges():
     s0 = np.asarray(p.syn0)
     assert s0.max() <= 0.5 / D and s0.min() >= -0.5 / D
     assert np.all(np.asarray(p.syn1) == 0)
+
+
+def test_duplicate_scaling_stabilizes_large_batches(setup):
+    # Pathological density: vocab 6, batch 512, lr 0.05 — accumulate-semantics diverges,
+    # scaled semantics must stay finite and learn (the sync-large-batch design point).
+    _, _, *_ = setup
+    counts = np.array([100, 90, 80, 70, 60, 50])
+    table6 = build_alias_table(counts)
+    params = init_embeddings(6, 16, jax.random.key(0))
+    params = EmbeddingPair(params.syn0,
+                           jax.random.normal(jax.random.key(1), (6, 16)) * 0.05)
+    rng = np.random.default_rng(0)
+    c = jnp.asarray(rng.integers(0, 6, 512), jnp.int32)
+    x = (c + 1) % 6
+    mask = jnp.ones(512, jnp.float32)
+    for i in range(50):
+        params, m = sgns_step(
+            params, c, x, mask, jax.random.key(i), 0.05, table6, N,
+            duplicate_scaling=True)
+    assert np.isfinite(float(m.loss))
+    assert np.all(np.isfinite(np.asarray(params.syn0)))
