@@ -1,0 +1,156 @@
+"""Benchmark: fused SGNS step throughput (word-pairs/sec) on the available accelerator.
+
+Measures the framework's hot path — the jitted gather → batched-dot → sigmoid →
+scatter-add SGNS update (glint_word2vec_tpu/ops/sgns.py) with on-device negative
+sampling — on a realistic single-chip config:
+
+    vocab 200k (Zipf counts), d=300, 8192 pairs/step, 5 negatives  (BASELINE configs 2-3
+    territory; the reference's per-minibatch RPC budget capped it at ~65 pairs per
+    round-trip, mllib:83-85)
+
+The reference publishes no numbers (BASELINE.md: "none"), so ``vs_baseline`` is measured,
+not quoted: the identical step math implemented with torch on the host CPU (gather +
+einsum + index_add_), i.e. "what this machine could do without the accelerator". Values
+> 1 mean the TPU path wins.
+
+Prints exactly one JSON line on stdout:
+    {"metric": "sgns_word_pairs_per_sec_per_chip", "value": N, "unit": "pairs/s",
+     "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V, D, B, NEG = 200_000, 300, 8192, 5
+POOL = 64          # shared negative pool (sgns_step_shared); reweighted to NEG semantics
+PAD_D = 384        # lane-padded physical dim (config.pad_vector_to_lanes)
+WARMUP, STEPS, SCAN_LEN = 2, 10, 20
+CPU_STEPS = 10
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def zipf_counts(v: int) -> np.ndarray:
+    return np.maximum(1e9 / (np.arange(v) + 10.0) ** 1.07, 5.0)
+
+
+def bench_tpu(counts: np.ndarray) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from glint_word2vec_tpu.ops.sampler import build_alias_table
+    from glint_word2vec_tpu.ops.sgns import (
+        EmbeddingPair, init_embeddings, sgns_step_shared)
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({dev.platform})")
+    table = build_alias_table(counts)
+    params = init_embeddings(V, D, jax.random.key(0))
+    # lane-pad the minor dim exactly as the Trainer does (config.pad_vector_to_lanes)
+    params = EmbeddingPair(
+        jnp.pad(params.syn0, ((0, 0), (0, PAD_D - D))),
+        jnp.pad(params.syn1, ((0, 0), (0, PAD_D - D))))
+
+    rng = np.random.default_rng(0)
+    centers = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+    contexts = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+    mask = jnp.ones(B, jnp.float32)
+    alpha = jnp.float32(0.025)
+
+    # SCAN_LEN steps per dispatch: amortizes host->device dispatch latency (significant
+    # through the remote-TPU tunnel) the same way the production trainer amortizes it by
+    # keeping batches large. Params are donated — updates are in-place in HBM.
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(params, base_key):
+        def body(p, i):
+            new_p, m = sgns_step_shared(
+                p, centers, contexts, mask, jax.random.fold_in(base_key, i),
+                alpha, table, NEG, POOL)
+            return new_p, m.loss
+        return jax.lax.scan(body, params, jnp.arange(SCAN_LEN))
+
+    t0 = time.perf_counter()
+    for i in range(WARMUP):
+        params, losses = run_chunk(params, jax.random.key(i))
+    jax.block_until_ready(params)
+    log(f"compile+warmup: {time.perf_counter() - t0:.1f}s, "
+        f"loss {float(losses[-1]):.4f}")
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        params, losses = run_chunk(params, jax.random.key(WARMUP + i))
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    pps = STEPS * SCAN_LEN * B / dt
+    log(f"accelerator: {STEPS}x{SCAN_LEN} steps in {dt:.3f}s -> {pps:,.0f} pairs/s "
+        f"({dt / (STEPS * SCAN_LEN) * 1e3:.2f} ms/step)")
+    return pps
+
+
+def bench_cpu_torch(counts: np.ndarray) -> float:
+    """Same step math on host CPU with torch (gather/einsum/index_add_)."""
+    import torch
+
+    torch.manual_seed(0)
+    g = torch.Generator().manual_seed(0)
+    syn0 = (torch.rand(V, D, generator=g) - 0.5) / D
+    syn1 = torch.zeros(V, D)
+    probs = torch.tensor(counts ** 0.75, dtype=torch.float64)
+    probs /= probs.sum()
+    alpha = 0.025
+    rng = np.random.default_rng(0)
+    centers = torch.tensor(rng.integers(0, V, B), dtype=torch.long)
+    contexts = torch.tensor(rng.integers(0, V, B), dtype=torch.long)
+
+    def step():
+        # identical shared-negative-pool algorithm as the accelerator side
+        negatives = torch.multinomial(probs.float(), POOL, replacement=True)
+        e_in = syn0[centers]
+        e_pos = syn1[contexts]
+        Z = syn1[negatives]
+        f_pos = (e_in * e_pos).sum(-1)
+        f_neg = e_in @ Z.T
+        neg_valid = (negatives[None, :] != contexts[:, None]).float()
+        g_pos = (1 - torch.sigmoid(f_pos)) * alpha
+        g_neg = (0 - torch.sigmoid(f_neg)) * alpha * neg_valid * (NEG / POOL)
+        d_in = g_pos[:, None] * e_pos + g_neg @ Z
+        syn0.index_add_(0, centers, d_in)
+        syn1.index_add_(0, contexts, g_pos[:, None] * e_in)
+        syn1.index_add_(0, negatives, g_neg.T @ e_in)
+
+    step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(CPU_STEPS):
+        step()
+    dt = time.perf_counter() - t0
+    pps = CPU_STEPS * B / dt
+    log(f"cpu-torch baseline: {CPU_STEPS} steps in {dt:.3f}s -> {pps:,.0f} pairs/s")
+    return pps
+
+
+def main() -> None:
+    counts = zipf_counts(V)
+    tpu_pps = bench_tpu(counts)
+    try:
+        cpu_pps = bench_cpu_torch(counts)
+    except Exception as e:  # torch missing or OOM: report absolute number only
+        log(f"cpu baseline failed: {e}")
+        cpu_pps = None
+    result = {
+        "metric": "sgns_word_pairs_per_sec_per_chip",
+        "value": round(tpu_pps),
+        "unit": "pairs/s",
+        "vs_baseline": round(tpu_pps / cpu_pps, 2) if cpu_pps else 1.0,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

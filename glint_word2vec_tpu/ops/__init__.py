@@ -3,6 +3,7 @@ from glint_word2vec_tpu.ops.sgns import (
     init_embeddings,
     sgns_loss,
     sgns_step,
+    sgns_step_shared,
     cbow_step,
     alpha_schedule,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "init_embeddings",
     "sgns_loss",
     "sgns_step",
+    "sgns_step_shared",
     "cbow_step",
     "alpha_schedule",
 ]

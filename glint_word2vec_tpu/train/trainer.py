@@ -39,6 +39,7 @@ from glint_word2vec_tpu.ops.sgns import (
     cbow_step,
     init_embeddings,
     sgns_step,
+    sgns_step_shared,
 )
 from glint_word2vec_tpu.parallel.mesh import MeshPlan, make_mesh, pad_vocab_for_sharding
 from glint_word2vec_tpu.train.checkpoint import TrainState, save_model
@@ -83,6 +84,13 @@ class Trainer:
             plan = make_mesh(*shape)
         self.plan = plan
         self.padded_vocab = pad_vocab_for_sharding(vocab.size, plan.num_model)
+        # Pad the minor dim to the TPU lane width: D=300 rows are misaligned and row
+        # gathers/scatters measurably slower than at 384. Padded columns are zero-init and
+        # receive zero gradient (all products with the zero columns vanish), so they stay
+        # zero and are sliced off on export.
+        self.padded_dim = (
+            -(-config.vector_size // 128) * 128
+            if config.pad_vector_to_lanes else config.vector_size)
         self.table = build_alias_table(vocab.counts, config.sample_power)
         self._root_key = jax.random.key(config.seed)
         if params is None:
@@ -90,8 +98,7 @@ class Trainer:
                 self.padded_vocab, config.vector_size,
                 jax.random.fold_in(self._root_key, 0),
                 dtype=jnp.dtype(config.param_dtype))
-        else:
-            params = self._pad_params(params)
+        params = self._pad_params(params)
         self.params = jax.tree.map(
             lambda a: jax.device_put(a, plan.embedding), params,
             is_leaf=lambda x: not isinstance(x, tuple))
@@ -103,18 +110,15 @@ class Trainer:
     # -- setup -------------------------------------------------------------------------
 
     def _pad_params(self, params: EmbeddingPair) -> EmbeddingPair:
-        V = params.syn0.shape[0]
-        if V == self.padded_vocab:
-            return params
-        pad = self.padded_vocab - V
-        return EmbeddingPair(
-            syn0=jnp.concatenate(
-                [jnp.asarray(params.syn0),
-                 jnp.zeros((pad, params.syn0.shape[1]), params.syn0.dtype)]),
-            syn1=jnp.concatenate(
-                [jnp.asarray(params.syn1),
-                 jnp.zeros((pad, params.syn1.shape[1]), params.syn1.dtype)]),
-        )
+        def pad(a):
+            a = jnp.asarray(a)
+            row_pad = self.padded_vocab - a.shape[0]
+            col_pad = self.padded_dim - a.shape[1]
+            if row_pad or col_pad:
+                a = jnp.pad(a, ((0, row_pad), (0, col_pad)))
+            return a
+
+        return EmbeddingPair(syn0=pad(params.syn0), syn1=pad(params.syn1))
 
     def _build_step(self) -> Callable:
         cfg = self.config
@@ -125,7 +129,23 @@ class Trainer:
             from glint_word2vec_tpu.ops.pallas import sgns_kernel  # deferred import
             inner = sgns_kernel.make_pallas_sgns_step(
                 table, cfg.negatives, cfg.sigmoid_mode, compute_dtype)
+        elif cfg.negative_pool > 0 and not cfg.cbow:
+            if cfg.duplicate_scaling:
+                logger.warning(
+                    "duplicate_scaling is not implemented for the negative_pool fast "
+                    "path; duplicated rows accumulate summed updates")
+
+            def inner(params, batch, key, alpha):
+                return sgns_step_shared(
+                    params, batch["centers"], batch["contexts"], batch["mask"],
+                    key, alpha, table, cfg.negatives, cfg.negative_pool,
+                    cfg.sigmoid_mode, compute_dtype)
         elif cfg.cbow:
+            if cfg.negative_pool > 0:
+                logger.warning(
+                    "negative_pool is not implemented for the CBOW path yet; "
+                    "using per-example negative sampling")
+
             def inner(params, batch, key, alpha):
                 return cbow_step(
                     params, batch["centers"], batch["contexts"], batch["ctx_mask"],
@@ -241,8 +261,9 @@ class Trainer:
     # -- export / persistence ----------------------------------------------------------
 
     def unpadded_params(self) -> EmbeddingPair:
-        V = self.vocab.size
-        return EmbeddingPair(syn0=self.params.syn0[:V], syn1=self.params.syn1[:V])
+        V, D = self.vocab.size, self.config.vector_size
+        return EmbeddingPair(syn0=self.params.syn0[:V, :D],
+                             syn1=self.params.syn1[:V, :D])
 
     def save_checkpoint(self, path: str) -> None:
         p = self.unpadded_params()

@@ -156,3 +156,33 @@ def test_config_object_plus_overrides():
     cfg = Word2VecConfig(vector_size=8)
     est = Word2Vec(cfg, window=2)
     assert est.config.vector_size == 8 and est.config.window == 2
+
+
+def test_negative_pool_and_lane_padding_end_to_end():
+    sents = two_topic_corpus(100)
+    cfg = dict(CFG)
+    cfg.update(negative_pool=16, vector_size=20)  # pads to 128 internally
+    model = Word2Vec(**cfg).fit(sents)
+    # exports are sliced back to the logical vector size
+    assert model.transform("a").shape == (20,)
+    words, mat = model.to_local()
+    assert mat.shape == (6, 20)
+    assert np.all(np.isfinite(mat))
+
+
+def test_lane_padding_columns_stay_zero():
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.train.trainer import Trainer
+    from glint_word2vec_tpu.config import Word2VecConfig
+
+    sents = two_topic_corpus(50)
+    vocab = build_vocab(sents, 1)
+    cfg = Word2VecConfig(vector_size=20, min_count=1, pairs_per_batch=64,
+                         num_iterations=1)
+    tr = Trainer(cfg, vocab)
+    assert tr.padded_dim == 128
+    tr.fit(encode_sentences(sents, vocab))
+    full = np.asarray(tr.params.syn0)
+    assert full.shape[1] == 128
+    np.testing.assert_array_equal(full[:, 20:], 0.0)

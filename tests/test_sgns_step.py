@@ -241,3 +241,49 @@ def test_duplicate_scaling_stabilizes_large_batches(setup):
             duplicate_scaling=True)
     assert np.isfinite(float(m.loss))
     assert np.all(np.isfinite(np.asarray(params.syn0)))
+
+
+def test_shared_negative_step_basics(setup):
+    from glint_word2vec_tpu.ops.sgns import sgns_step_shared
+    params, table, centers, contexts, mask = setup
+    P = 16
+    new_params, m = sgns_step_shared(
+        params, centers, contexts, mask, jax.random.key(0), 0.05, table, N, P)
+    assert np.all(np.isfinite(np.asarray(new_params.syn0)))
+    assert float(m.pairs) == B
+    # masked batch -> no update, zero loss
+    zp, zm = sgns_step_shared(
+        params, centers, contexts, jnp.zeros(B, jnp.float32),
+        jax.random.key(0), 0.05, table, N, P)
+    np.testing.assert_array_equal(np.asarray(zp.syn0), np.asarray(params.syn0))
+    np.testing.assert_array_equal(np.asarray(zp.syn1), np.asarray(params.syn1))
+    assert float(zm.loss) == 0.0
+
+
+def test_shared_negative_step_learns(setup):
+    from glint_word2vec_tpu.ops.sgns import sgns_step_shared
+    params, table, *_ = setup
+    rng = np.random.default_rng(3)
+    c = jnp.asarray(rng.integers(0, 10, 256), jnp.int32)
+    x = (c + 1) % 10
+    mask = jnp.ones(256, jnp.float32)
+    step = jax.jit(lambda p, k: sgns_step_shared(p, c, x, mask, k, 0.02, table, N, 16))
+    losses = []
+    for i in range(60):
+        params, m = step(params, jax.random.key(i))
+        losses.append(float(m.loss))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_shared_negative_pool_collision_masked():
+    # Vocab of 1: the whole pool == every context word -> zero negative gradient.
+    from glint_word2vec_tpu.ops.sgns import sgns_step_shared
+    params = EmbeddingPair(syn0=jnp.ones((1, 4)) * 0.1, syn1=jnp.ones((1, 4)) * 0.1)
+    table = build_alias_table(np.array([10]))
+    centers = contexts = jnp.zeros(8, jnp.int32)
+    mask = jnp.ones(8, jnp.float32)
+    _, m = sgns_step_shared(
+        params, centers, contexts, mask, jax.random.key(0), 0.1, table, 5, 4)
+    f = float(jnp.sum(params.syn0[0] * params.syn1[0]))
+    expected_loss = -np.log(1.0 / (1.0 + np.exp(-f)))
+    np.testing.assert_allclose(float(m.loss), expected_loss, rtol=1e-5)

@@ -1,0 +1,104 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh (conftest).
+
+The TPU analog of the reference's Docker "multi-node" integration mechanism (SURVEY §4):
+validate that training and model ops compile and execute with the embeddings row-sharded
+over the 'model' axis and batches split over 'data' — the layout that replaces the Glint
+parameter-server sharding (G2, README.md:69).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.pipeline import encode_sentences
+from glint_word2vec_tpu.data.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.models.word2vec import Word2VecModel
+from glint_word2vec_tpu.parallel.mesh import make_mesh, pad_vocab_for_sharding
+from glint_word2vec_tpu.train.trainer import Trainer
+
+
+def test_make_mesh_shapes():
+    plan = make_mesh(2, 4)
+    assert plan.num_data == 2 and plan.num_model == 4
+    plan = make_mesh(1)  # auto model axis = all devices
+    assert plan.num_model == 8
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(3, 4)  # 12 > 8
+
+
+def test_pad_vocab_for_sharding():
+    assert pad_vocab_for_sharding(3611, 1) == 3616   # lane multiple 8
+    assert pad_vocab_for_sharding(3611, 4) == 3616
+    assert pad_vocab_for_sharding(3611, 5) == 3640   # lcm(5,8)=40
+    assert pad_vocab_for_sharding(40, 5) == 40       # already aligned
+
+
+def test_sharded_training_runs_and_layout():
+    rng = np.random.default_rng(0)
+    sents = [[f"w{i}" for i in rng.integers(0, 50, 12)] for _ in range(60)]
+    vocab = build_vocab(sents, 1)
+    enc = encode_sentences(sents, vocab)
+    plan = make_mesh(2, 4)
+    cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=64,
+                         num_iterations=2, window=3)
+    trainer = Trainer(cfg, vocab, plan=plan)
+    assert trainer.padded_vocab % 4 == 0
+    trainer.fit(enc)
+    # params stayed row-sharded across donated updates
+    assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
+    assert trainer.params.syn1.sharding.is_equivalent_to(plan.embedding, 2)
+    p = trainer.unpadded_params()
+    assert np.all(np.isfinite(np.asarray(p.syn0)))
+
+
+def test_sharded_model_ops_match_unsharded():
+    rng = np.random.default_rng(1)
+    V, D = 37, 12  # deliberately not divisible by the model axis
+    words = [f"w{i}" for i in range(V)]
+    vocab = Vocabulary.from_words_and_counts(words, np.arange(V, 0, -1))
+    syn0 = rng.normal(size=(V, D)).astype(np.float32)
+
+    base = Word2VecModel(vocab, syn0.copy())
+    plan = make_mesh(1, 8)
+    sharded = Word2VecModel(vocab, syn0.copy(), plan=plan)
+    assert sharded._full0.shape[0] == pad_vocab_for_sharding(V, 8)
+
+    # every model op agrees with the unsharded computation
+    np.testing.assert_allclose(sharded.transform("w3"), base.transform("w3"), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sharded.norms), np.asarray(base.norms), rtol=1e-5)
+    q = rng.normal(size=D).astype(np.float32)
+    np.testing.assert_allclose(sharded.multiply(q), base.multiply(q),
+                               rtol=1e-4, atol=1e-5)
+    s_sharded = sharded.find_synonyms("w0", 5)
+    s_base = base.find_synonyms("w0", 5)
+    assert [w for w, _ in s_sharded] == [w for w, _ in s_base]
+    np.testing.assert_allclose([s for _, s in s_sharded], [s for _, s in s_base],
+                               rtol=1e-4)
+    # padded zero rows never leak into results, even for num >= vocab
+    all_syns = sharded.find_synonyms("w0", 50)
+    assert len(all_syns) == V - 1
+
+
+def test_data_parallel_batch_sharding():
+    plan = make_mesh(4, 2)
+    arr = np.arange(64, dtype=np.int32)
+    out = jax.device_put(arr, plan.batch)
+    assert out.sharding.is_equivalent_to(plan.batch, 1)
+    np.testing.assert_array_equal(np.asarray(out), arr)
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    loss = jax.jit(fn)(*args)
+    assert np.isfinite(float(loss))
+
+
+def test_graft_entry_dryrun_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+    ge.dryrun_multichip(2)
