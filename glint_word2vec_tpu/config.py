@@ -94,7 +94,19 @@ class Word2VecConfig:
 
     # --- lr decay semantics (mllib:405-413) ---
     min_alpha_factor: float = 1e-4  # floor alpha at learning_rate * 1e-4 (mllib:410)
-    decay_interval_words: int = 10_000  # recompute alpha every 10k words (mllib:404)
+    decay_interval_words: int = 10_000  # reference alpha cadence (mllib:404) — here alpha
+                                        # updates every batch (host-side, free); kept for
+                                        # compat surface
+    steps_per_dispatch: int = 16    # train steps scanned inside one device dispatch;
+                                    # amortizes host->device dispatch/transfer latency
+                                    # (dominant through a remote-TPU tunnel, still real
+                                    # on-pod); the last chunk of an epoch is padded with
+                                    # masked batches
+    heartbeat_every_steps: int = 100  # telemetry cadence. The reference logs every 10k
+                                      # words (one 50-pair minibatch era); fetching device
+                                      # metrics forces a host sync, so at 8k-pair batches a
+                                      # word-based cadence would sync nearly every step and
+                                      # halve throughput
 
     def __post_init__(self) -> None:
         if self.vector_size <= 0:

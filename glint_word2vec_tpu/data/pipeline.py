@@ -196,6 +196,59 @@ class PairBatcher:
             yield (*padded, n)
 
 
+def _block_pairs(
+    tokens: np.ndarray,          # int32 [N] concatenated sentence tokens
+    lengths: np.ndarray,         # int64 [S] sentence lengths (sum == N)
+    keep: np.ndarray,            # float64 [V] per-word keep probability
+    window: int,
+    rng: np.random.Generator,
+    legacy_asymmetric_window: bool,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Subsample + dynamic-window pair generation for a whole block of sentences in a
+    handful of vectorized ops (no per-sentence Python loop — the hot host path; a
+    per-sentence equivalent exists as :func:`subsample_sentence` +
+    :func:`dynamic_window_pairs` for unit-testing the formulas).
+
+    Returns (centers, contexts, center_word_index, words_kept) where
+    ``center_word_index[p]`` is the kept-word ordinal (within this block) of pair p's
+    center — the per-pair lr-decay clock, so downstream batches can credit exactly the
+    words consumed *up to each batch* rather than the whole block at once."""
+    N = tokens.shape[0]
+    empty = (np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0, np.int64), 0)
+    if N == 0:
+        return empty
+    sent_ids = np.repeat(np.arange(lengths.shape[0]), lengths)
+    # subsample the whole block at once (mllib:371-379 semantics)
+    kept_mask = rng.random(N) <= keep[tokens]
+    toks = tokens[kept_mask]
+    sids = sent_ids[kept_mask]
+    Nk = toks.shape[0]
+    if Nk == 0:
+        return empty
+    # per-sentence positions after subsampling
+    new_lengths = np.bincount(sids, minlength=lengths.shape[0])
+    new_starts = np.concatenate([[0], np.cumsum(new_lengths)])[:-1]
+    pos = np.arange(Nk, dtype=np.int64) - new_starts[sids]
+    slen = new_lengths[sids]
+    # dynamic window draw (mllib:384-388)
+    b = rng.integers(0, window, size=Nk)
+    left = np.minimum(b, pos)
+    right_extent = b if not legacy_asymmetric_window else b - 1
+    right = np.clip(np.minimum(right_extent, slen - 1 - pos), 0, None)
+    total = left + right
+    num_pairs = int(total.sum())
+    if num_pairs == 0:
+        return (np.empty(0, np.int32), np.empty(0, np.int32),
+                np.empty(0, np.int64), int(Nk))
+    center_flat = np.repeat(np.arange(Nk, dtype=np.int64), total)
+    group_starts = np.cumsum(total) - total
+    offsets = np.arange(num_pairs, dtype=np.int64) - np.repeat(group_starts, total)
+    left_rep = np.repeat(left, total)
+    ctx_flat = center_flat - left_rep + offsets + (offsets >= left_rep)
+    return (toks[center_flat].astype(np.int32), toks[ctx_flat].astype(np.int32),
+            center_flat + 1, int(Nk))
+
+
 def epoch_batches(
     sentences: Sequence[np.ndarray],
     vocab: Vocabulary,
@@ -210,6 +263,7 @@ def epoch_batches(
     shuffle: bool = True,
     legacy_asymmetric_window: bool = True,
     flush_last: bool = True,
+    block_words: int = 1_000_000,
 ) -> Iterator[PairBatch]:
     """One iteration's stream of fixed-shape pair batches for one data shard.
 
@@ -217,7 +271,9 @@ def epoch_batches(
     window draw each iteration, deterministic per (seed, iteration, shard) — the analog of
     the XORShift reseed ``seed ^ ((idx+1)<<16) ^ ((-k-1)<<8)`` at mllib:372,382.
 
-    Sentences are round-robin assigned to shards (the analog of repartition, mllib:345).
+    Sentences are round-robin assigned to shards (the analog of repartition, mllib:345)
+    and processed in ~``block_words``-word blocks, each block fully vectorized
+    (:func:`_block_pairs`) — the host must outrun a TPU consuming millions of pairs/s.
     """
     rng = np.random.default_rng(
         np.random.SeedSequence(entropy=seed, spawn_key=(iteration, shard)))
@@ -225,21 +281,44 @@ def epoch_batches(
     order = np.arange(shard, len(sentences), num_shards)
     if shuffle:
         rng.shuffle(order)
-    batcher = PairBatcher(pairs_per_batch)
+    batcher = PairBatcher(pairs_per_batch, num_streams=3)
+    words_base = 0   # kept words fully consumed in prior blocks
     words_seen = 0
-    for si in order:
-        sub = subsample_sentence(sentences[si], keep, rng)
-        # The reference counts the *subsampled* sentence length into its decay clock
-        # (wc += sentence.length at mllib:414 operates on the subsampled sentence).
-        words_seen += int(sub.shape[0])
-        c, x = dynamic_window_pairs(sub, window, rng, legacy_asymmetric_window)
-        batcher.add(c, x)
-        for bc, bx, n in batcher.drain():
+
+    def block_iter():
+        block: List[np.ndarray] = []
+        nwords = 0
+        for si in order:
+            s = sentences[si]
+            block.append(s)
+            nwords += s.shape[0]
+            if nwords >= block_words:
+                yield block
+                block, nwords = [], 0
+        if block:
+            yield block
+
+    for block in block_iter():
+        tokens = np.concatenate(block) if len(block) > 1 else block[0]
+        lengths = np.fromiter((s.shape[0] for s in block), np.int64, len(block))
+        c, x, clock, kept = _block_pairs(
+            tokens, lengths, keep, window, rng, legacy_asymmetric_window)
+        # The reference counts *subsampled* words into its decay clock (mllib:414); the
+        # per-pair clock credits words as their pairs are actually emitted, so alpha
+        # advances per batch, not per block.
+        batcher.add(c, x, words_base + clock)
+        words_base += kept
+        for bc, bx, bclock, n in batcher.drain():
             mask = np.ones(pairs_per_batch, np.float32)
+            words_seen = int(bclock[n - 1])
             yield PairBatch(bc, bx, mask, words_seen, n)
-    for bc, bx, n in batcher.drain(flush=flush_last):
+    for bc, bx, bclock, n in batcher.drain(flush=flush_last):
         mask = (np.arange(pairs_per_batch) < n).astype(np.float32)
+        words_seen = int(bclock[n - 1]) if n else words_seen
         yield PairBatch(bc, bx, mask, words_seen, n)
+    # trailing subsampled words with no emitted pairs still count toward the clock for
+    # the *next* iteration's prev_words baseline — callers use iteration boundaries, so
+    # nothing further to emit here
 
 
 def count_train_words(sentences: Sequence[np.ndarray]) -> int:

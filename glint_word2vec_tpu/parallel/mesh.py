@@ -57,6 +57,12 @@ class MeshPlan:
         return NamedSharding(self.mesh, P(DATA_AXIS))
 
     @property
+    def batch_stacked(self) -> NamedSharding:
+        """[K, B, ...] chunk-of-batches: leading scan axis replicated, batch axis split
+        over data."""
+        return NamedSharding(self.mesh, P(None, DATA_AXIS))
+
+    @property
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 

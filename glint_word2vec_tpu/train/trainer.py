@@ -103,6 +103,7 @@ class Trainer:
             lambda a: jax.device_put(a, plan.embedding), params,
             is_leaf=lambda x: not isinstance(x, tuple))
         self.state = train_state or TrainState()
+        self._chunk_sharding = plan.batch_stacked
         self.global_step = 0
         self.heartbeats: List[HeartbeatRecord] = []
         self._step_fn = self._build_step()
@@ -158,14 +159,27 @@ class Trainer:
                     key, alpha, table, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
                     cfg.duplicate_scaling)
 
-        def step(params, batch, key, alpha):
-            # keep the embeddings row-sharded across updates; the batch rides the data axis
-            new_params, metrics = inner(params, batch, key, alpha)
-            new_params = jax.lax.with_sharding_constraint(
-                new_params, EmbeddingPair(plan.embedding, plan.embedding))
-            return new_params, metrics
+        root_key = self._root_key
 
-        return jax.jit(step, donate_argnums=(0,))
+        def chunk(params, batches, base_step, alphas):
+            # scan over steps_per_dispatch stacked batches in one device dispatch:
+            # per-step dispatch/transfer latency (large through a remote-TPU tunnel)
+            # would otherwise dominate the ~ms step. Per-step PRNG keys are derived
+            # on-device from the scalar base step (nothing but the batch crosses the
+            # host boundary). The embeddings stay row-sharded across donated updates;
+            # batches ride the data axis.
+            def body(p, inp):
+                batch, alpha, offset = inp
+                key = jax.random.fold_in(root_key, base_step + offset)
+                new_p, metrics = inner(p, batch, key, alpha)
+                new_p = jax.lax.with_sharding_constraint(
+                    new_p, EmbeddingPair(plan.embedding, plan.embedding))
+                return new_p, metrics
+
+            offsets = jnp.arange(alphas.shape[0], dtype=jnp.int32)
+            return jax.lax.scan(body, params, (batches, alphas, offsets))
+
+        return jax.jit(chunk, donate_argnums=(0,))
 
     # -- training ----------------------------------------------------------------------
 
@@ -186,39 +200,55 @@ class Trainer:
         train_words = expected_kept_words(
             self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
         total_words = float(cfg.num_iterations * train_words + 1)
-        last_logged_words = -cfg.decay_interval_words
         last_log_time = time.perf_counter()
         last_log_step = self.global_step
         pending_metrics: Optional[StepMetrics] = None
 
+        K = max(1, cfg.steps_per_dispatch)
         start_iter = self.state.iteration
         for k in range(start_iter, cfg.num_iterations + 1):
             prev_words = (k - 1) * train_words
-            stream = self._batch_stream(sentences, k)
-            for batch in stream:
-                words_global = prev_words + batch.pop("words_seen")
-                alpha = alpha_schedule(
-                    float(words_global), total_words, cfg.learning_rate,
-                    cfg.min_alpha_factor)
-                key = jax.random.fold_in(self._root_key, self.global_step + 1)
-                device_batch = {
-                    name: jax.device_put(arr, self.plan.batch)
-                    for name, arr in batch.items()
-                }
-                self.params, pending_metrics = self._step_fn(
-                    self.params, device_batch, key, jnp.float32(alpha))
-                self.global_step += 1
-                self.state = TrainState(iteration=k, words_processed=int(words_global))
+            pending: List[dict] = []
+            pending_words: List[int] = []
 
-                if words_global - last_logged_words >= cfg.decay_interval_words:
-                    # fetch forces a sync; only done at heartbeat cadence (mllib:404-413)
+            def dispatch():
+                nonlocal pending, pending_words, pending_metrics
+                nonlocal last_log_time, last_log_step
+                if not pending:
+                    return
+                real = len(pending)
+                while len(pending) < K:  # pad to the compiled chunk length, masked out
+                    dummy = {name: np.zeros_like(arr)
+                             for name, arr in pending[0].items()}
+                    pending.append(dummy)
+                    pending_words.append(pending_words[-1])
+                stacked = {
+                    name: jax.device_put(
+                        np.stack([b[name] for b in pending]), self._chunk_sharding)
+                    for name in pending[0]
+                }
+                alphas = np.asarray([
+                    alpha_schedule(float(w), total_words, cfg.learning_rate,
+                                   cfg.min_alpha_factor)
+                    for w in pending_words], np.float32)
+                self.params, pending_metrics = self._step_fn(
+                    self.params, stacked, np.int32(self.global_step + 1), alphas)
+                self.global_step += real
+                self.state = TrainState(
+                    iteration=k, words_processed=int(pending_words[real - 1]))
+
+                if self.global_step - last_log_step >= cfg.heartbeat_every_steps:
+                    # metric fetch forces a device sync; chunked cadence keeps the
+                    # async dispatch pipeline full (the reference's every-10k-words
+                    # line, mllib:404-413, assumed 50-pair minibatches)
                     now = time.perf_counter()
                     steps = self.global_step - last_log_step
                     pps = steps * cfg.pairs_per_batch / max(now - last_log_time, 1e-9)
                     rec = HeartbeatRecord(
-                        words=int(words_global), alpha=float(alpha),
-                        loss=float(pending_metrics.loss),
-                        mean_f_pos=float(pending_metrics.mean_f_pos),
+                        words=self.state.words_processed,
+                        alpha=float(alphas[real - 1]),
+                        loss=float(pending_metrics.loss[real - 1]),
+                        mean_f_pos=float(pending_metrics.mean_f_pos[real - 1]),
                         pairs_per_sec=pps)
                     self.heartbeats.append(rec)
                     logger.info(
@@ -227,12 +257,19 @@ class Trainer:
                         rec.mean_f_pos, rec.pairs_per_sec)
                     if on_heartbeat is not None:
                         on_heartbeat(rec)
-                    last_logged_words = int(words_global)
                     last_log_time, last_log_step = now, self.global_step
 
+                pending, pending_words = [], []
                 if (checkpoint_path and checkpoint_every_steps
-                        and self.global_step % checkpoint_every_steps == 0):
+                        and self.global_step % checkpoint_every_steps < real):
                     self.save_checkpoint(checkpoint_path)
+
+            for batch in self._batch_stream(sentences, k):
+                pending_words.append(prev_words + batch.pop("words_seen"))
+                pending.append(batch)
+                if len(pending) == K:
+                    dispatch()
+            dispatch()
 
         self.state = TrainState(
             iteration=cfg.num_iterations,
