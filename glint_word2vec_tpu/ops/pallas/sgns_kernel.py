@@ -1,0 +1,293 @@
+"""Fused Pallas TPU kernel for the shared-negative SGNS step.
+
+This is the "native kernel" tier of the framework — the replacement for the reference's
+server-side Scala compute (G3 ``dotprod`` + G4 ``adjust``, mllib:419-425) that the
+BASELINE north star asks to lower to Pallas.
+
+Why a kernel at all: profiling shows the XLA step is row-access bound — the embedding
+row gathers and read-modify-write scatters of ~1.5 KB rows dominate, with the MXU nearly
+idle. The kernel fuses the whole update into one pass over each row:
+
+    HBM row ──DMA──▶ VMEM ──compute f, g, Δ──▶ updated row ──DMA──▶ same HBM row
+
+so each touched row is read once and written once (the XLA lowering reads rows for the
+gather, then reads them again inside the scatter's read-modify-write), with a ring of
+``NBUF`` outstanding row DMAs to hide HBM latency, and the negative-pool math
+(``f_neg = E_in @ Zᵀ``, ``ΔZ = g_negᵀ @ E_in``) on the MXU from VMEM.
+
+Concurrency semantics: grid tiles execute sequentially on a TensorCore, so cross-tile
+duplicate rows are consistent. *Within* a tile, duplicate rows are gathered before either
+update is applied and written back last-wins — i.e. one of the duplicate updates is
+dropped. This is strictly tamer than the reference's accepted cross-worker Hogwild races
+(README.md:17-19, "Use a small number [of partitions] for accuracy"); the jnp paths
+(:func:`..sgns.sgns_step_shared`) remain the exact-accumulation reference implementation
+and the default.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from glint_word2vec_tpu.ops.sampler import AliasTable, sample_negatives
+from glint_word2vec_tpu.ops.sgns import MAX_EXP, EmbeddingPair, StepMetrics
+
+NBUF = 8  # outstanding row-DMA ring depth per stream
+
+
+def _sigmoid(f, mode: str):
+    if mode == "clipped":
+        return jnp.where(f > MAX_EXP, 1.0,
+                         jnp.where(f < -MAX_EXP, 0.0, jax.nn.sigmoid(f)))
+    return jax.nn.sigmoid(f)
+
+
+def _sgns_tile_kernel(
+    # scalar prefetch
+    centers_ref,      # SMEM [B] int32
+    contexts_ref,     # SMEM [B] int32
+    # inputs
+    alpha_ref,        # SMEM (1, 1) f32
+    ctx_ref,          # VMEM (T, 1) int32 — this tile's context ids (for collision mask)
+    mask_ref,         # VMEM (T, 1) f32
+    negs_ref,         # VMEM (1, P) int32
+    z_ref,            # VMEM (P, D) f32 — gathered negative-pool rows
+    syn0_ref,         # ANY  [Vp, D] f32 (aliased with syn0_out)
+    syn1_ref,         # ANY  [Vp, D] f32 (aliased with syn1_out)
+    # outputs
+    syn0_out,         # ANY  [Vp, D]
+    syn1_out,         # ANY  [Vp, D]
+    dz_out,           # VMEM (P, D) f32 — negative-pool delta, applied by the host
+    fpos_out,         # VMEM (T, 1) f32
+    nloss_out,        # VMEM (1, 1) f32 — accumulated negative-term loss sum
+    # scratch
+    ein,              # VMEM (T, D) f32
+    epos,             # VMEM (T, D) f32
+    gsem0,            # DMA sems (NBUF,)
+    gsem1,
+    wsem0,
+    wsem1,
+    *,
+    tile: int,
+    neg_ratio: float,
+    sigmoid_mode: str,
+):
+    t = pl.program_id(0)
+    base = t * tile
+
+    def g0(i):
+        return pltpu.make_async_copy(
+            syn0_ref.at[centers_ref[base + i]], ein.at[i], gsem0.at[i % NBUF])
+
+    def g1(i):
+        return pltpu.make_async_copy(
+            syn1_ref.at[contexts_ref[base + i]], epos.at[i], gsem1.at[i % NBUF])
+
+    # ---- gather phase: ring of NBUF outstanding row copies per stream ----
+    for w in range(NBUF):
+        g0(w).start()
+        g1(w).start()
+
+    def gather_body(i, _):
+        g0(i).wait()
+        g1(i).wait()
+
+        @pl.when(i + NBUF < tile)
+        def _():
+            g0(i + NBUF).start()
+            g1(i + NBUF).start()
+
+        return ()
+
+    jax.lax.fori_loop(0, tile, gather_body, (), unroll=False)
+
+    # ---- compute phase (VPU + MXU, all in VMEM) ----
+    e_in = ein[...]
+    e_pos = epos[...]
+    z = z_ref[...]
+    alpha = alpha_ref[0, 0]
+    mask = mask_ref[...]                                     # (T, 1)
+
+    f_pos = jnp.sum(e_in * e_pos, axis=1, keepdims=True)     # (T, 1)
+    f_neg = jnp.dot(e_in, z.T, preferred_element_type=jnp.float32)  # (T, P) MXU
+    neg_valid = (ctx_ref[...] != negs_ref[...]).astype(jnp.float32) * mask
+
+    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask
+    g_neg = (0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid * neg_ratio
+
+    new_ein = e_in + g_pos * e_pos + jnp.dot(
+        g_neg, z, preferred_element_type=jnp.float32)
+    new_epos = e_pos + g_pos * e_in
+    dz = jnp.dot(g_neg.T, e_in, preferred_element_type=jnp.float32)  # (P, D) MXU
+
+    @pl.when(t == 0)
+    def _():
+        dz_out[...] = jnp.zeros_like(dz_out)
+        nloss_out[...] = jnp.zeros_like(nloss_out)
+
+    dz_out[...] += dz
+    fpos_out[...] = f_pos
+    # −Σ log σ(−f_neg) over valid entries, reweighted like the gradient
+    nloss_out[...] += jnp.sum(
+        jax.nn.softplus(f_neg) * neg_valid).reshape(1, 1) * neg_ratio
+
+    ein[...] = new_ein
+    epos[...] = new_epos
+
+    # ---- writeback phase: same ring, rows go back to their HBM slots ----
+    def w0(i):
+        return pltpu.make_async_copy(
+            ein.at[i], syn0_out.at[centers_ref[base + i]], wsem0.at[i % NBUF])
+
+    def w1(i):
+        return pltpu.make_async_copy(
+            epos.at[i], syn1_out.at[contexts_ref[base + i]], wsem1.at[i % NBUF])
+
+    for w in range(NBUF):
+        w0(w).start()
+        w1(w).start()
+
+    def write_body(i, _):
+        w0(i).wait()
+        w1(i).wait()
+
+        @pl.when(i + NBUF < tile)
+        def _():
+            w0(i + NBUF).start()
+            w1(i + NBUF).start()
+
+        return ()
+
+    # all writes complete before this tile ends: the next tile may read these rows
+    jax.lax.fori_loop(0, tile, write_body, (), unroll=False)
+
+
+def fused_sgns_shared(
+    syn0: jax.Array,       # [Vp, D] f32, D a multiple of 128
+    syn1: jax.Array,
+    centers: jax.Array,    # [B] int32
+    contexts: jax.Array,   # [B] int32
+    mask: jax.Array,       # [B] f32
+    negatives: jax.Array,  # [P] int32
+    z: jax.Array,          # [P, D] f32 — syn1 rows of the pool (gathered by caller)
+    alpha: jax.Array,      # scalar f32
+    num_negatives: int,
+    sigmoid_mode: str = "exact",
+    tile: int = 512,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Run the fused kernel. Returns (syn0', syn1', dZ, f_pos, neg_loss_sum);
+    the caller applies ``syn1'.at[negatives].add(dZ)``."""
+    B = centers.shape[0]
+    Vp, D = syn0.shape
+    P = z.shape[0]
+    if B % tile:
+        raise ValueError(f"batch {B} not divisible by tile {tile}")
+    num_tiles = B // tile
+    neg_ratio = float(num_negatives) / float(P)
+
+    kernel = functools.partial(
+        _sgns_tile_kernel, tile=tile, neg_ratio=neg_ratio, sigmoid_mode=sigmoid_mode)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, *_: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, 1), lambda i, *_: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i, *_: (i, 0)),
+            pl.BlockSpec((1, P), lambda i, *_: (0, 0)),
+            pl.BlockSpec((P, D), lambda i, *_: (0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((P, D), lambda i, *_: (0, 0)),
+            pl.BlockSpec((tile, 1), lambda i, *_: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i, *_: (0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tile, D), jnp.float32),
+            pltpu.VMEM((tile, D), jnp.float32),
+            pltpu.SemaphoreType.DMA((NBUF,)),
+            pltpu.SemaphoreType.DMA((NBUF,)),
+            pltpu.SemaphoreType.DMA((NBUF,)),
+            pltpu.SemaphoreType.DMA((NBUF,)),
+        ],
+    )
+
+    out_shape = [
+        jax.ShapeDtypeStruct((Vp, D), jnp.float32),   # syn0'
+        jax.ShapeDtypeStruct((Vp, D), jnp.float32),   # syn1'
+        jax.ShapeDtypeStruct((P, D), jnp.float32),    # dZ
+        jax.ShapeDtypeStruct((B, 1), jnp.float32),    # f_pos
+        jax.ShapeDtypeStruct((1, 1), jnp.float32),    # neg loss sum
+    ]
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        # operand indices include the 2 scalar-prefetch args:
+        # 2=alpha 3=ctx 4=mask 5=negs 6=z 7=syn0 8=syn1
+        input_output_aliases={7: 0, 8: 1},
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(
+        centers, contexts,
+        alpha.reshape(1, 1).astype(jnp.float32),
+        contexts.reshape(-1, 1)
+        .reshape(num_tiles * tile, 1),
+        mask.reshape(-1, 1),
+        negatives.reshape(1, P),
+        z,
+        syn0, syn1,
+    )
+
+
+def make_pallas_sgns_step(
+    table: AliasTable,
+    num_negatives: int,
+    negative_pool: int,
+    sigmoid_mode: str = "exact",
+    compute_dtype=jnp.float32,
+    tile: int = 512,
+    interpret: bool = False,
+):
+    """Trainer-facing factory: returns ``inner(params, batch, key, alpha)`` with the same
+    contract as the jnp steps (the Pallas analog of :func:`..sgns.sgns_step_shared`)."""
+    del compute_dtype  # kernel is float32; bf16 variant is future work
+    P = negative_pool if negative_pool > 0 else 64
+
+    def inner(params: EmbeddingPair, batch, key, alpha):
+        syn0, syn1 = params
+        centers = batch["centers"]
+        contexts = batch["contexts"]
+        mask = batch["mask"]
+        negatives = sample_negatives(table, key, (P,))
+        z = syn1[negatives]
+        new_syn0, new_syn1, dz, f_pos, nloss = fused_sgns_shared(
+            syn0, syn1, centers, contexts, mask, negatives, z, alpha,
+            num_negatives, sigmoid_mode, tile=tile, interpret=interpret)
+        new_syn1 = new_syn1.at[negatives].add(dz.astype(new_syn1.dtype))
+
+        f_pos = f_pos[:, 0]
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = ((jax.nn.softplus(-f_pos) * mask).sum() + nloss[0, 0]) / denom
+        metrics = StepMetrics(
+            loss=loss,
+            mean_f_pos=(f_pos * mask).sum() / denom,
+            pairs=mask.sum(),
+        )
+        return EmbeddingPair(new_syn0, new_syn1), metrics
+
+    return inner
