@@ -15,13 +15,23 @@ gather, then reads them again inside the scatter's read-modify-write), with a ri
 ``NBUF`` outstanding row DMAs to hide HBM latency, and the negative-pool math
 (``f_neg = E_in @ Zᵀ``, ``ΔZ = g_negᵀ @ E_in``) on the MXU from VMEM.
 
+Layout: Mosaic only allows DMA slices aligned to the (8, 128) f32 tiling, so single
+embedding rows cannot be sliced out of a 2-D ``[Vp, D]`` array (dim 0 is a tiled
+sublane dim). The kernel therefore views both matrices as ``[Vp, S, 128]`` with
+``S = D // 128`` — dim 0 becomes an untiled "array" dim that can be indexed at row
+granularity, and each row is an (S, 128) block. The 2-D↔3-D reshape is a free layout
+no-op on TPU (measured ~0.07 ms for a 1.5 GB matrix — metadata only, not a copy).
+Compute runs per 128-lane slab: ``f_neg = Σ_s E[:, s, :] @ Z[:, s, :]ᵀ`` keeps the
+contractions on the MXU with K = 128 per pass.
+
 Concurrency semantics: grid tiles execute sequentially on a TensorCore, so cross-tile
 duplicate rows are consistent. *Within* a tile, duplicate rows are gathered before either
 update is applied and written back last-wins — i.e. one of the duplicate updates is
 dropped. This is strictly tamer than the reference's accepted cross-worker Hogwild races
 (README.md:17-19, "Use a small number [of partitions] for accuracy"); the jnp paths
 (:func:`..sgns.sgns_step_shared`) remain the exact-accumulation reference implementation
-and the default.
+and the default. Padded rows (mask == 0) are skipped at writeback so they cannot alias
+row 0 (see the masked-writeback predicate below).
 """
 
 from __future__ import annotations
@@ -35,7 +45,6 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from glint_word2vec_tpu.ops.sampler import AliasTable, sample_negatives
 from glint_word2vec_tpu.ops.sgns import MAX_EXP, EmbeddingPair, StepMetrics
 
 NBUF = 8  # outstanding row-DMA ring depth per stream
@@ -48,6 +57,11 @@ def _sigmoid(f, mode: str):
     return jax.nn.sigmoid(f)
 
 
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _sgns_tile_kernel(
     # scalar prefetch
     centers_ref,      # SMEM [B] int32
@@ -57,18 +71,18 @@ def _sgns_tile_kernel(
     ctx_ref,          # VMEM (T, 1) int32 — this tile's context ids (for collision mask)
     mask_ref,         # VMEM (T, 1) f32
     negs_ref,         # VMEM (1, P) int32
-    z_ref,            # VMEM (P, D) f32 — gathered negative-pool rows
-    syn0_ref,         # ANY  [Vp, D] f32 (aliased with syn0_out)
-    syn1_ref,         # ANY  [Vp, D] f32 (aliased with syn1_out)
+    z_ref,            # VMEM (P, S, 128) f32 — gathered negative-pool rows
+    syn0_ref,         # ANY  [Vp, S, 128] f32 (aliased with syn0_out)
+    syn1_ref,         # ANY  [Vp, S, 128] f32 (aliased with syn1_out)
     # outputs
-    syn0_out,         # ANY  [Vp, D]
-    syn1_out,         # ANY  [Vp, D]
-    dz_out,           # VMEM (P, D) f32 — negative-pool delta, applied by the host
+    syn0_out,         # ANY  [Vp, S, 128]
+    syn1_out,         # ANY  [Vp, S, 128]
+    dz_out,           # VMEM (P, S, 128) f32 — negative-pool delta, applied by the host
     fpos_out,         # VMEM (T, 1) f32
     nloss_out,        # VMEM (1, 1) f32 — accumulated negative-term loss sum
     # scratch
-    ein,              # VMEM (T, D) f32
-    epos,             # VMEM (T, D) f32
+    ein,              # VMEM (T, S, 128) f32
+    epos,             # VMEM (T, S, 128) f32
     gsem0,            # DMA sems (NBUF,)
     gsem1,
     wsem0,
@@ -80,6 +94,7 @@ def _sgns_tile_kernel(
 ):
     t = pl.program_id(0)
     base = t * tile
+    S = ein.shape[1]
 
     def g0(i):
         return pltpu.make_async_copy(
@@ -107,40 +122,48 @@ def _sgns_tile_kernel(
 
     jax.lax.fori_loop(0, tile, gather_body, (), unroll=False)
 
-    # ---- compute phase (VPU + MXU, all in VMEM) ----
-    e_in = ein[...]
-    e_pos = epos[...]
-    z = z_ref[...]
+    # ---- compute phase (VPU + MXU, all in VMEM, per 128-lane slab) ----
+    e = ein[...]                                             # (T, S, 128)
+    p = epos[...]
+    z = z_ref[...]                                           # (P, S, 128)
     alpha = alpha_ref[0, 0]
     mask = mask_ref[...]                                     # (T, 1)
 
-    f_pos = jnp.sum(e_in * e_pos, axis=1, keepdims=True)     # (T, 1)
-    f_neg = jnp.dot(e_in, z.T, preferred_element_type=jnp.float32)  # (T, P) MXU
+    f_pos = jnp.zeros((tile, 1), jnp.float32)
+    f_neg = jnp.zeros((tile, z.shape[0]), jnp.float32)
+    for s in range(S):
+        f_pos += jnp.sum(e[:, s, :] * p[:, s, :], axis=1, keepdims=True)
+        f_neg += _dot(e[:, s, :], z[:, s, :], ((1,), (1,)))  # (T, P) MXU
+
     neg_valid = (ctx_ref[...] != negs_ref[...]).astype(jnp.float32) * mask
 
     g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask
     g_neg = (0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid * neg_ratio
-
-    new_ein = e_in + g_pos * e_pos + jnp.dot(
-        g_neg, z, preferred_element_type=jnp.float32)
-    new_epos = e_pos + g_pos * e_in
-    dz = jnp.dot(g_neg.T, e_in, preferred_element_type=jnp.float32)  # (P, D) MXU
 
     @pl.when(t == 0)
     def _():
         dz_out[...] = jnp.zeros_like(dz_out)
         nloss_out[...] = jnp.zeros_like(nloss_out)
 
-    dz_out[...] += dz
     fpos_out[...] = f_pos
     # −Σ log σ(−f_neg) over valid entries, reweighted like the gradient
     nloss_out[...] += jnp.sum(
         jax.nn.softplus(f_neg) * neg_valid).reshape(1, 1) * neg_ratio
 
-    ein[...] = new_ein
-    epos[...] = new_epos
+    for s in range(S):
+        es, ps, zs = e[:, s, :], p[:, s, :], z[:, s, :]
+        ein[:, s, :] = es + g_pos * ps + _dot(g_neg, zs, ((1,), (0,)))   # MXU
+        epos[:, s, :] = ps + g_pos * es
+        dz_out[:, s, :] += _dot(g_neg, es, ((0,), (0,)))                 # (P, 128) MXU
 
     # ---- writeback phase: same ring, rows go back to their HBM slots ----
+    # Padded rows (mask == 0) are skipped entirely: their centers/contexts are 0, so an
+    # unconditional writeback would alias vocab row 0 and could overwrite (last-wins) a
+    # real row-0 update made earlier in the same tile. Start and wait share the per-row
+    # predicate, so every started DMA is waited exactly once.
+    def live(i):
+        return mask_ref[i, 0] != 0.0
+
     def w0(i):
         return pltpu.make_async_copy(
             ein.at[i], syn0_out.at[centers_ref[base + i]], wsem0.at[i % NBUF])
@@ -150,17 +173,25 @@ def _sgns_tile_kernel(
             epos.at[i], syn1_out.at[contexts_ref[base + i]], wsem1.at[i % NBUF])
 
     for w in range(NBUF):
-        w0(w).start()
-        w1(w).start()
+        @pl.when(live(w))
+        def _(w=w):
+            w0(w).start()
+            w1(w).start()
 
     def write_body(i, _):
-        w0(i).wait()
-        w1(i).wait()
-
-        @pl.when(i + NBUF < tile)
+        @pl.when(live(i))
         def _():
-            w0(i + NBUF).start()
-            w1(i + NBUF).start()
+            w0(i).wait()
+            w1(i).wait()
+
+        # clamp the lookahead index so the mask read stays in bounds; the outer
+        # predicate makes the clamped duplicate read irrelevant
+        nxt = jnp.minimum(i + NBUF, tile - 1)
+
+        @pl.when((i + NBUF < tile) & live(nxt))
+        def _():
+            w0(nxt).start()
+            w1(nxt).start()
 
         return ()
 
@@ -189,8 +220,21 @@ def fused_sgns_shared(
     P = z.shape[0]
     if B % tile:
         raise ValueError(f"batch {B} not divisible by tile {tile}")
+    if tile < NBUF:
+        raise ValueError(f"tile {tile} smaller than the DMA ring depth {NBUF}")
+    if D % 128:
+        raise ValueError(
+            f"vector dim {D} must be a multiple of 128 for the fused kernel "
+            "(enable pad_vector_to_lanes)")
+    S = D // 128
     num_tiles = B // tile
     neg_ratio = float(num_negatives) / float(P)
+
+    # free layout view: row r becomes the (S, 128) block at untiled dim-0 index r,
+    # which is the granularity Mosaic DMAs can address
+    syn0v = syn0.reshape(Vp, S, 128)
+    syn1v = syn1.reshape(Vp, S, 128)
+    zv = z.reshape(P, S, 128)
 
     kernel = functools.partial(
         _sgns_tile_kernel, tile=tile, neg_ratio=neg_ratio, sigmoid_mode=sigmoid_mode)
@@ -203,20 +247,20 @@ def fused_sgns_shared(
             pl.BlockSpec((tile, 1), lambda i, *_: (i, 0)),
             pl.BlockSpec((tile, 1), lambda i, *_: (i, 0)),
             pl.BlockSpec((1, P), lambda i, *_: (0, 0)),
-            pl.BlockSpec((P, D), lambda i, *_: (0, 0)),
+            pl.BlockSpec((P, S, 128), lambda i, *_: (0, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((P, D), lambda i, *_: (0, 0)),
+            pl.BlockSpec((P, S, 128), lambda i, *_: (0, 0, 0)),
             pl.BlockSpec((tile, 1), lambda i, *_: (i, 0)),
             pl.BlockSpec((1, 1), lambda i, *_: (0, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((tile, D), jnp.float32),
-            pltpu.VMEM((tile, D), jnp.float32),
+            pltpu.VMEM((tile, S, 128), jnp.float32),
+            pltpu.VMEM((tile, S, 128), jnp.float32),
             pltpu.SemaphoreType.DMA((NBUF,)),
             pltpu.SemaphoreType.DMA((NBUF,)),
             pltpu.SemaphoreType.DMA((NBUF,)),
@@ -225,14 +269,14 @@ def fused_sgns_shared(
     )
 
     out_shape = [
-        jax.ShapeDtypeStruct((Vp, D), jnp.float32),   # syn0'
-        jax.ShapeDtypeStruct((Vp, D), jnp.float32),   # syn1'
-        jax.ShapeDtypeStruct((P, D), jnp.float32),    # dZ
-        jax.ShapeDtypeStruct((B, 1), jnp.float32),    # f_pos
-        jax.ShapeDtypeStruct((1, 1), jnp.float32),    # neg loss sum
+        jax.ShapeDtypeStruct((Vp, S, 128), jnp.float32),   # syn0'
+        jax.ShapeDtypeStruct((Vp, S, 128), jnp.float32),   # syn1'
+        jax.ShapeDtypeStruct((P, S, 128), jnp.float32),    # dZ
+        jax.ShapeDtypeStruct((B, 1), jnp.float32),         # f_pos
+        jax.ShapeDtypeStruct((1, 1), jnp.float32),         # neg loss sum
     ]
 
-    return pl.pallas_call(
+    new_syn0, new_syn1, dz, f_pos, nloss = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
@@ -245,17 +289,17 @@ def fused_sgns_shared(
     )(
         centers, contexts,
         alpha.reshape(1, 1).astype(jnp.float32),
-        contexts.reshape(-1, 1)
-        .reshape(num_tiles * tile, 1),
+        contexts.reshape(num_tiles * tile, 1),
         mask.reshape(-1, 1),
         negatives.reshape(1, P),
-        z,
-        syn0, syn1,
+        zv,
+        syn0v, syn1v,
     )
+    return (new_syn0.reshape(Vp, D), new_syn1.reshape(Vp, D),
+            dz.reshape(P, D), f_pos, nloss)
 
 
 def make_pallas_sgns_step(
-    table: AliasTable,
     num_negatives: int,
     negative_pool: int,
     sigmoid_mode: str = "exact",
@@ -263,21 +307,33 @@ def make_pallas_sgns_step(
     tile: int = 512,
     interpret: bool = False,
 ):
-    """Trainer-facing factory: returns ``inner(params, batch, key, alpha)`` with the same
-    contract as the jnp steps (the Pallas analog of :func:`..sgns.sgns_step_shared`)."""
+    """Trainer-facing factory: returns ``inner(params, batch, negatives, alpha)`` with
+    the same contract as the jnp step cores (the Pallas analog of
+    :func:`..sgns.sgns_step_shared_core`); the trainer pre-draws the shared pool."""
     del compute_dtype  # kernel is float32; bf16 variant is future work
-    P = negative_pool if negative_pool > 0 else 64
+    del negative_pool  # pool size is read off the pre-drawn negatives
 
-    def inner(params: EmbeddingPair, batch, key, alpha):
+    def inner(params: EmbeddingPair, batch, negatives, alpha):
         syn0, syn1 = params
         centers = batch["centers"]
         contexts = batch["contexts"]
         mask = batch["mask"]
-        negatives = sample_negatives(table, key, (P,))
+        # shrink the tile to the batch when the batch is smaller (tests, toy
+        # corpora); larger batches must divide the tile — one giant tile would
+        # blow the VMEM scratch budget
+        B = centers.shape[0]
+        if B % tile == 0:
+            t = tile
+        elif B < tile:
+            t = B
+        else:
+            raise ValueError(
+                f"pairs_per_batch {B} must be a multiple of the kernel tile "
+                f"{tile} (or smaller than it) for use_pallas=True")
         z = syn1[negatives]
         new_syn0, new_syn1, dz, f_pos, nloss = fused_sgns_shared(
             syn0, syn1, centers, contexts, mask, negatives, z, alpha,
-            num_negatives, sigmoid_mode, tile=tile, interpret=interpret)
+            num_negatives, sigmoid_mode, tile=t, interpret=interpret)
         new_syn1 = new_syn1.at[negatives].add(dz.astype(new_syn1.dtype))
 
         f_pos = f_pos[:, 0]

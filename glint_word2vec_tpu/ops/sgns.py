@@ -136,10 +136,28 @@ def sgns_step(
     cost of slower differentiation (frequent rows see one averaged step per batch). Default
     off: textbook accumulate semantics, the reference's math.
     """
+    negatives = sample_negatives(table, key, (centers.shape[0], num_negatives))
+    return sgns_step_core(params, centers, contexts, mask, negatives, alpha,
+                          sigmoid_mode, compute_dtype, duplicate_scaling)
+
+
+def sgns_step_core(
+    params: EmbeddingPair,
+    centers: jax.Array,    # int32 [B]
+    contexts: jax.Array,   # int32 [B]
+    mask: jax.Array,       # float32 [B]
+    negatives: jax.Array,  # int32 [B, n] — pre-drawn (hot path: ops.sampler.sample_negatives_hash)
+    alpha: jax.Array,
+    sigmoid_mode: str = "exact",
+    compute_dtype: jnp.dtype = jnp.float32,
+    duplicate_scaling: bool = False,
+) -> Tuple[EmbeddingPair, StepMetrics]:
+    """:func:`sgns_step` with the negatives supplied by the caller — the form the
+    trainer jits (sampling happens once per dispatch chunk, outside the scan, because
+    in-program threefry is catastrophically slow on TPU; see ops/prng.py)."""
     syn0, syn1 = params
     B = centers.shape[0]
     V = syn0.shape[0]
-    negatives = sample_negatives(table, key, (B, num_negatives))
     neg_valid = (negatives != contexts[:, None]).astype(jnp.float32) * mask[:, None]
 
     e_in = syn0[centers].astype(compute_dtype)          # [B, D]
@@ -216,9 +234,26 @@ def sgns_step_shared(
 
     Pool entries equal to a pair's positive context are masked per (pair, pool) entry.
     """
+    negatives = sample_negatives(table, key, (negative_pool,))
+    return sgns_step_shared_core(params, centers, contexts, mask, negatives, alpha,
+                                 num_negatives, sigmoid_mode, compute_dtype)
+
+
+def sgns_step_shared_core(
+    params: EmbeddingPair,
+    centers: jax.Array,    # int32 [B]
+    contexts: jax.Array,   # int32 [B]
+    mask: jax.Array,       # float32 [B]
+    negatives: jax.Array,  # int32 [P] — pre-drawn shared pool
+    alpha: jax.Array,
+    num_negatives: int,
+    sigmoid_mode: str = "exact",
+    compute_dtype: jnp.dtype = jnp.float32,
+) -> Tuple[EmbeddingPair, StepMetrics]:
+    """:func:`sgns_step_shared` with the pool supplied by the caller (see
+    :func:`sgns_step_core` for why sampling lives outside the jitted scan)."""
     syn0, syn1 = params
-    P = negative_pool
-    negatives = sample_negatives(table, key, (P,))
+    P = negatives.shape[0]
     e_in = syn0[centers].astype(compute_dtype)          # [B, D]
     e_pos = syn1[contexts].astype(compute_dtype)        # [B, D]
     Z = syn1[negatives].astype(compute_dtype)           # [P, D]
@@ -275,9 +310,27 @@ def cbow_step(
     example. Context-vector gradients are the hidden gradient divided equally (mean
     convention), scattered back to every context position.
     """
+    negatives = sample_negatives(table, key, (centers.shape[0], num_negatives))
+    return cbow_step_core(params, centers, contexts, ctx_mask, mask, negatives, alpha,
+                          sigmoid_mode, compute_dtype, duplicate_scaling)
+
+
+def cbow_step_core(
+    params: EmbeddingPair,
+    centers: jax.Array,     # int32 [B]
+    contexts: jax.Array,    # int32 [B, C]
+    ctx_mask: jax.Array,    # float32 [B, C]
+    mask: jax.Array,        # float32 [B]
+    negatives: jax.Array,   # int32 [B, n] — pre-drawn
+    alpha: jax.Array,
+    sigmoid_mode: str = "exact",
+    compute_dtype: jnp.dtype = jnp.float32,
+    duplicate_scaling: bool = False,
+) -> Tuple[EmbeddingPair, StepMetrics]:
+    """:func:`cbow_step` with the negatives supplied by the caller (see
+    :func:`sgns_step_core` for why sampling lives outside the jitted scan)."""
     syn0, syn1 = params
     B, C = contexts.shape
-    negatives = sample_negatives(table, key, (B, num_negatives))
     neg_valid = (negatives != centers[:, None]).astype(jnp.float32) * mask[:, None]
 
     e_ctx = syn0[contexts].astype(compute_dtype)                      # [B, C, D]

@@ -31,15 +31,15 @@ import numpy as np
 from glint_word2vec_tpu.config import Word2VecConfig
 from glint_word2vec_tpu.data.pipeline import epoch_batches, epoch_batches_cbow
 from glint_word2vec_tpu.data.vocab import Vocabulary
-from glint_word2vec_tpu.ops.sampler import build_alias_table
+from glint_word2vec_tpu.ops.sampler import build_alias_table, sample_negatives_hash
 from glint_word2vec_tpu.ops.sgns import (
     EmbeddingPair,
     StepMetrics,
     alpha_schedule,
-    cbow_step,
+    cbow_step_core,
     init_embeddings,
-    sgns_step,
-    sgns_step_shared,
+    sgns_step_core,
+    sgns_step_shared_core,
 )
 from glint_word2vec_tpu.parallel.mesh import MeshPlan, make_mesh, pad_vocab_for_sharding
 from glint_word2vec_tpu.train.checkpoint import TrainState, save_model
@@ -92,6 +92,11 @@ class Trainer:
             -(-config.vector_size // 128) * 128
             if config.pad_vector_to_lanes else config.vector_size)
         self.table = build_alias_table(vocab.counts, config.sample_power)
+        # replicated device copies, passed into the jitted chunk as ARGUMENTS every
+        # dispatch — closure-captured constants take a catastrophically slow gather
+        # path on TPU (see ops/prng.py)
+        self._table_prob = jax.device_put(self.table.prob, plan.replicated)
+        self._table_alias = jax.device_put(self.table.alias, plan.replicated)
         self._root_key = jax.random.key(config.seed)
         if params is None:
             params = init_embeddings(
@@ -105,6 +110,7 @@ class Trainer:
         self.state = train_state or TrainState()
         self._chunk_sharding = plan.batch_stacked
         self.global_step = 0
+        self.pairs_trained = 0.0  # real (unmasked) pairs dispatched over this run
         self.heartbeats: List[HeartbeatRecord] = []
         self._step_fn = self._build_step()
 
@@ -123,61 +129,81 @@ class Trainer:
 
     def _build_step(self) -> Callable:
         cfg = self.config
-        table = self.table
         compute_dtype = jnp.dtype(cfg.compute_dtype)
         plan = self.plan
+        seed = cfg.seed & 0xFFFFFFFF
         if cfg.use_pallas:
             from glint_word2vec_tpu.ops.pallas import sgns_kernel  # deferred import
+            if len(plan.mesh.devices.flat) > 1:
+                raise ValueError(
+                    "use_pallas=True currently supports single-device plans only: the "
+                    "fused kernel owns the whole [V, D] matrices in one HBM space and "
+                    "cannot be GSPMD-partitioned; use the XLA negative_pool path on "
+                    "multi-device meshes")
+            if cfg.cbow:
+                raise ValueError("use_pallas=True is not implemented for CBOW")
             inner = sgns_kernel.make_pallas_sgns_step(
-                table, cfg.negatives, cfg.sigmoid_mode, compute_dtype)
+                cfg.negatives, cfg.negative_pool, cfg.sigmoid_mode, compute_dtype,
+                interpret=jax.default_backend() == "cpu")
+            pool = cfg.negative_pool if cfg.negative_pool > 0 else 64
+            neg_shape = lambda K, B: (K, pool)  # noqa: E731
         elif cfg.negative_pool > 0 and not cfg.cbow:
             if cfg.duplicate_scaling:
                 logger.warning(
                     "duplicate_scaling is not implemented for the negative_pool fast "
                     "path; duplicated rows accumulate summed updates")
 
-            def inner(params, batch, key, alpha):
-                return sgns_step_shared(
+            def inner(params, batch, negatives, alpha):
+                return sgns_step_shared_core(
                     params, batch["centers"], batch["contexts"], batch["mask"],
-                    key, alpha, table, cfg.negatives, cfg.negative_pool,
-                    cfg.sigmoid_mode, compute_dtype)
+                    negatives, alpha, cfg.negatives, cfg.sigmoid_mode, compute_dtype)
+
+            neg_shape = lambda K, B: (K, cfg.negative_pool)  # noqa: E731
         elif cfg.cbow:
             if cfg.negative_pool > 0:
                 logger.warning(
                     "negative_pool is not implemented for the CBOW path yet; "
                     "using per-example negative sampling")
 
-            def inner(params, batch, key, alpha):
-                return cbow_step(
+            def inner(params, batch, negatives, alpha):
+                return cbow_step_core(
                     params, batch["centers"], batch["contexts"], batch["ctx_mask"],
-                    batch["mask"], key, alpha, table, cfg.negatives,
+                    batch["mask"], negatives, alpha,
                     cfg.sigmoid_mode, compute_dtype, cfg.duplicate_scaling)
+
+            neg_shape = lambda K, B: (K, B, cfg.negatives)  # noqa: E731
         else:
-            def inner(params, batch, key, alpha):
-                return sgns_step(
+            def inner(params, batch, negatives, alpha):
+                return sgns_step_core(
                     params, batch["centers"], batch["contexts"], batch["mask"],
-                    key, alpha, table, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
+                    negatives, alpha, cfg.sigmoid_mode, compute_dtype,
                     cfg.duplicate_scaling)
 
-        root_key = self._root_key
+            neg_shape = lambda K, B: (K, B, cfg.negatives)  # noqa: E731
 
-        def chunk(params, batches, base_step, alphas):
+        def chunk(params, batches, base_step, alphas, prob, alias):
             # scan over steps_per_dispatch stacked batches in one device dispatch:
             # per-step dispatch/transfer latency (large through a remote-TPU tunnel)
-            # would otherwise dominate the ~ms step. Per-step PRNG keys are derived
-            # on-device from the scalar base step (nothing but the batch crosses the
-            # host boundary). The embeddings stay row-sharded across donated updates;
-            # batches ride the data axis.
+            # would otherwise dominate the ~ms step. Two hard-won TPU constraints
+            # (measured 3.4M → 200M+ pairs/s on v5e, see ops/prng.py):
+            #  - no jax.random (threefry) ops anywhere in this program — negatives
+            #    come from the counter-based hash PRNG, drawn for the whole chunk
+            #    before the scan;
+            #  - the alias tables enter as jit arguments (prob, alias), never as
+            #    closure constants.
+            K = alphas.shape[0]
+            B = batches["centers"].shape[1]
+            negatives = sample_negatives_hash(
+                prob, alias, seed, base_step, neg_shape(K, B))
+
             def body(p, inp):
-                batch, alpha, offset = inp
-                key = jax.random.fold_in(root_key, base_step + offset)
-                new_p, metrics = inner(p, batch, key, alpha)
+                batch, alpha, negs = inp
+                new_p, metrics = inner(p, batch, negs, alpha)
                 new_p = jax.lax.with_sharding_constraint(
                     new_p, EmbeddingPair(plan.embedding, plan.embedding))
                 return new_p, metrics
 
-            offsets = jnp.arange(alphas.shape[0], dtype=jnp.int32)
-            return jax.lax.scan(body, params, (batches, alphas, offsets))
+            return jax.lax.scan(body, params, (batches, alphas, negatives))
 
         return jax.jit(chunk, donate_argnums=(0,))
 
@@ -202,6 +228,7 @@ class Trainer:
         total_words = float(cfg.num_iterations * train_words + 1)
         last_log_time = time.perf_counter()
         last_log_step = self.global_step
+        pairs_since_log = [0.0]  # mutable cell for the dispatch() closure
         pending_metrics: Optional[StepMetrics] = None
 
         K = max(1, cfg.steps_per_dispatch)
@@ -232,8 +259,12 @@ class Trainer:
                                    cfg.min_alpha_factor)
                     for w in pending_words], np.float32)
                 self.params, pending_metrics = self._step_fn(
-                    self.params, stacked, np.int32(self.global_step + 1), alphas)
+                    self.params, stacked, np.int32(self.global_step + 1), alphas,
+                    self._table_prob, self._table_alias)
                 self.global_step += real
+                real_pairs = sum(float(b["mask"].sum()) for b in pending[:real])
+                pairs_since_log[0] += real_pairs
+                self.pairs_trained += real_pairs
                 self.state = TrainState(
                     iteration=k, words_processed=int(pending_words[real - 1]))
 
@@ -242,8 +273,9 @@ class Trainer:
                     # async dispatch pipeline full (the reference's every-10k-words
                     # line, mllib:404-413, assumed 50-pair minibatches)
                     now = time.perf_counter()
-                    steps = self.global_step - last_log_step
-                    pps = steps * cfg.pairs_per_batch / max(now - last_log_time, 1e-9)
+                    # throughput counts real (unmasked) pairs, not padded batch slots
+                    pps = pairs_since_log[0] / max(now - last_log_time, 1e-9)
+                    pairs_since_log[0] = 0.0
                     rec = HeartbeatRecord(
                         words=self.state.words_processed,
                         alpha=float(alphas[real - 1]),
