@@ -26,7 +26,7 @@ Architecture (vs. the reference, cited as file:line into the reference repo):
 
 Module map: ``data/`` (vocab + host pipeline), ``ops/`` (SGNS/CBOW steps, sampler,
 pallas kernels), ``parallel/`` (mesh + sharding), ``models/`` (model & estimator API),
-``train/`` (trainer, checkpoint), ``utils/``.
+``train/`` (trainer, checkpoint).
 """
 
 from glint_word2vec_tpu.config import Word2VecConfig

@@ -88,6 +88,9 @@ class Word2VecConfig:
     param_dtype: str = "float32"    # embedding storage dtype
     compute_dtype: str = "float32"  # dot-product dtype ("bfloat16" rides the MXU)
     use_pallas: bool = False        # fused Pallas SGNS kernel for the hot step
+    sharded_checkpoint: bool = False  # row-shards save (each process writes its own
+                                      # rows, no host gather — G9 analog); forced on
+                                      # for multi-process runs
     cbow: bool = False              # CBOW variant (context-mean → center) instead of skip-gram
     shuffle: bool = True            # shuffle sentence order each iteration (reference order is
                                     # whatever repartition() produced, i.e. arbitrary; mllib:345)

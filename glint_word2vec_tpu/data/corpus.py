@@ -1,0 +1,142 @@
+"""Streaming corpus ingestion — train from token files with bounded host RAM.
+
+The reference trains from RDDs of arbitrary size (mllib:310-345); the single-host analog
+is a token file too large to hold as Python lists (enwiki ≈ 3B words ≈ tens of GB as
+strings). Ingestion is therefore two streaming passes over a re-iterable corpus:
+
+    pass 1: :func:`..data.vocab.build_vocab` — a Counter, O(vocab) RAM
+    pass 2: :func:`encode_corpus` — words → int32 ids written straight to disk
+
+after which training reads the encoded shards through ``np.memmap`` (O(1) resident per
+access; the OS page cache does the rest). :class:`EncodedCorpus` satisfies the
+``Sequence[np.ndarray]`` contract of :func:`..data.pipeline.epoch_batches`, so the
+trainer is oblivious to whether sentences live in RAM or on disk.
+
+Layout of an encoded dir (two flat binary files + a small JSON):
+
+    tokens.bin   int32  [total_tokens]     all sentences concatenated
+    offsets.bin  int64  [n_sentences + 1]  sentence i = tokens[offsets[i]:offsets[i+1]]
+    meta.json    {"n_sentences", "total_tokens", "max_sentence_length"}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Iterator, List, Sequence
+
+import numpy as np
+
+from glint_word2vec_tpu.data.vocab import Vocabulary
+
+_TOKENS = "tokens.bin"
+_OFFSETS = "offsets.bin"
+_META = "meta.json"
+
+
+class TokenFileCorpus:
+    """Re-iterable sentence stream over a whitespace-tokenized text file
+    (one sentence per line — the text8/enwiki-style input). Nothing is held in RAM;
+    every ``__iter__`` re-opens the file, so the vocab pass and the encode pass can
+    each stream it independently."""
+
+    def __init__(self, path: str, lowercase: bool = False):
+        self.path = path
+        self.lowercase = lowercase
+
+    def __iter__(self) -> Iterator[List[str]]:
+        with open(self.path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                if self.lowercase:
+                    line = line.lower()
+                toks = line.split()
+                if toks:
+                    yield toks
+
+
+class EncodedCorpus(Sequence):
+    """Memory-mapped encoded sentences: the disk-backed analog of the
+    ``List[np.ndarray]`` that :func:`..data.pipeline.encode_sentences` returns."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        with open(os.path.join(directory, _META), "r", encoding="utf-8") as f:
+            self.meta = json.load(f)
+        n = self.meta["n_sentences"]
+        self._tokens = np.memmap(
+            os.path.join(directory, _TOKENS), dtype=np.int32, mode="r")
+        self._offsets = np.memmap(
+            os.path.join(directory, _OFFSETS), dtype=np.int64, mode="r",
+            shape=(n + 1,))
+        if int(self._offsets[-1]) != self._tokens.shape[0]:
+            raise ValueError(
+                f"corrupt encoded corpus at {directory}: last offset "
+                f"{int(self._offsets[-1])} != token count {self._tokens.shape[0]}")
+
+    def __len__(self) -> int:
+        return self.meta["n_sentences"]
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if isinstance(i, slice):
+            raise TypeError("EncodedCorpus supports integer indexing only")
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        return np.asarray(self._tokens[self._offsets[i]:self._offsets[i + 1]])
+
+    @property
+    def total_tokens(self) -> int:
+        return self.meta["total_tokens"]
+
+
+def encode_corpus(
+    sentences: Iterable[Sequence[str]],
+    vocab: Vocabulary,
+    out_dir: str,
+    max_sentence_length: int = 1000,
+    buffer_sentences: int = 8192,
+) -> EncodedCorpus:
+    """One streaming pass: words → vocab ids (OOV dropped), chunked to
+    ``max_sentence_length`` (the C4 contract, mllib:335-343), appended to disk.
+
+    Peak RAM is O(buffer + offsets): the int64 offset list is the only thing that
+    grows with corpus size (8 bytes per sentence — 600 MB even at enwiki's ~75M
+    sentences would be the worst case; tokens stream straight through)."""
+    os.makedirs(out_dir, exist_ok=True)
+    index = vocab.index
+    offsets: List[int] = [0]
+    total = 0
+    buf: List[np.ndarray] = []
+    buffered = 0
+
+    with open(os.path.join(out_dir, _TOKENS), "wb") as tf:
+        def flush():
+            nonlocal buf, buffered
+            if buf:
+                np.concatenate(buf).tofile(tf)
+                buf, buffered = [], 0
+
+        for sentence in sentences:
+            ids = [index[w] for w in sentence if w in index]
+            if not ids:
+                continue
+            arr = np.asarray(ids, dtype=np.int32)
+            for start in range(0, len(arr), max_sentence_length):
+                chunk = arr[start:start + max_sentence_length]
+                if not chunk.size:
+                    continue
+                buf.append(chunk)
+                buffered += 1
+                total += int(chunk.size)
+                offsets.append(total)
+                if buffered >= buffer_sentences:
+                    flush()
+        flush()
+
+    np.asarray(offsets, dtype=np.int64).tofile(os.path.join(out_dir, _OFFSETS))
+    with open(os.path.join(out_dir, _META), "w", encoding="utf-8") as f:
+        json.dump({"n_sentences": len(offsets) - 1, "total_tokens": total,
+                   "max_sentence_length": max_sentence_length}, f)
+    return EncodedCorpus(out_dir)

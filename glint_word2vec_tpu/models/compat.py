@@ -62,6 +62,7 @@ class ServerSideGlintWord2Vec:
         self._parameter_server_config: Dict = {}
         self._unigram_table_size = 100_000_000
         self._seed = 0
+        self._device_batch_set = False  # did the user touch batchSize/numPartitions?
         self._input_col = "sentence"
         self._output_col = "vector"
 
@@ -79,6 +80,7 @@ class ServerSideGlintWord2Vec:
 
     def setNumPartitions(self, value: int) -> "ServerSideGlintWord2Vec":
         self._num_partitions = int(value)
+        self._device_batch_set = True
         return self
 
     def setNumIterations(self, value: int) -> "ServerSideGlintWord2Vec":
@@ -106,6 +108,7 @@ class ServerSideGlintWord2Vec:
 
     def setBatchSize(self, value: int) -> "ServerSideGlintWord2Vec":
         self._batch_size = int(value)
+        self._device_batch_set = True
         self._check_payload_constraint()
         return self
 
@@ -165,6 +168,19 @@ class ServerSideGlintWord2Vec:
         n_shards = self._num_parameter_servers
         import jax
         n_dev = len(jax.devices())
+        kwargs = {}
+        if self._device_batch_set:
+            # The reference trains batchSize pairs per partition concurrently
+            # (mllib:417-429), numPartitions partitions at once — so the faithful
+            # device-batch mapping is their product. Only applied when the user set
+            # either knob; the config default (8192) is far better for the MXU.
+            pairs = max(self._batch_size * self._num_partitions, 1)
+            kwargs["pairs_per_batch"] = pairs
+            if pairs < 1024:
+                warnings.warn(
+                    f"batchSize*numPartitions = {pairs} maps to pairs_per_batch={pairs}"
+                    ": tiny device batches waste the TPU (default 8192); this mapping "
+                    "is faithful to the reference semantics, not fast", stacklevel=2)
         return Word2VecConfig(
             vector_size=self._vector_size,
             learning_rate=self._learning_rate,
@@ -179,6 +195,7 @@ class ServerSideGlintWord2Vec:
             num_model_shards=min(n_shards, n_dev),
             unigram_table_size=self._unigram_table_size,
             seed=self._seed,
+            **kwargs,
         )
 
     def fit(self, sentences: Iterable[Sequence[str]]) -> "ServerSideGlintWord2VecModel":

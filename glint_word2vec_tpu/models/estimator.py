@@ -44,17 +44,30 @@ class Word2Vec:
         vocab: Optional[Vocabulary] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every_steps: Optional[int] = None,
+        encode_cache_dir: Optional[str] = None,
     ) -> Word2VecModel:
         """sentences: iterable of token sequences (the RDD[Iterable[String]] analog,
-        mllib:310). Consumed twice when ``vocab`` is not given (vocab pass + encode
-        pass), so pass a list or re-iterable."""
+        mllib:310). Re-iterables (lists, :class:`..data.corpus.TokenFileCorpus`) are
+        streamed twice (vocab pass + encode pass) without materialization; one-shot
+        generators are materialized to a list first.
+
+        ``encode_cache_dir``: write the encoded corpus there and train from
+        memory-mapped shards — bounded host RAM for corpora that don't fit as
+        Python lists (see data/corpus.py). Without it, encoding is in-RAM.
+        """
         cfg = self.config
-        sentences = sentences if isinstance(sentences, (list, tuple)) else list(sentences)
+        if iter(sentences) is sentences:  # one-shot generator: must materialize
+            sentences = list(sentences)
         if vocab is None:
             vocab = build_vocab(sentences, cfg.min_count)
         logger.info("vocabSize = %d, trainWordsCount = %d",
                     vocab.size, vocab.train_words_count)
-        encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
+        if encode_cache_dir is not None:
+            from glint_word2vec_tpu.data.corpus import encode_corpus
+            encoded = encode_corpus(
+                sentences, vocab, encode_cache_dir, cfg.max_sentence_length)
+        else:
+            encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
         trainer = Trainer(cfg, vocab, plan=plan)
         trainer.fit(encoded, checkpoint_path=checkpoint_path,
                     checkpoint_every_steps=checkpoint_every_steps)

@@ -8,17 +8,30 @@ ml:504-507) while replacing HDFS matrix shards with array files:
                      with the reference's sidecar, mllib:495-496)
       counts.npy     per-word corpus counts (needed to rebuild the negative-sampling
                      table on resume; the reference re-broadcasts vocabCns instead)
-      syn0.npy       input embeddings [V, D] float32
-      syn1.npy       output embeddings [V, D] float32 (present iff trainable state saved;
-                     the reference's save keeps both matrices alive on the PS too)
+      syn0.npy       input embeddings [V, D] float32            (dense layout)
+      syn1.npy       output embeddings [V, D] float32 (present iff trainable state saved)
+      syn0.shards/rows-<start>-<stop>.npy                       (row-shards layout)
+      syn1.shards/rows-<start>-<stop>.npy
       metadata.json  config + format version + train_state — the analog of the ML layer's
                      DefaultParamsWriter metadata (ml:504-507)
 
+Two matrix layouts behind one directory contract:
+
+- **dense** — host numpy arrays, one ``.npy`` per matrix. Fine up to a few GB.
+- **row-shards** — the G9 analog of the reference's PS-side shard write
+  (``matrix.save``, mllib:493-497): every process writes only the row ranges its own
+  devices hold (``Array.addressable_shards``), so nothing is ever gathered to one host
+  — at the 10M×300 north star each of 16 hosts writes ~0.75 GB instead of one host
+  materializing 12 GB per matrix. Shards are written PADDED (as sharded in HBM) with
+  the real (vocab_size, vector_size) recorded in metadata; readers slice.
+
+``load_model`` reads either layout into host arrays; :func:`load_params_into_plan`
+streams row-shards straight into a (possibly different) target mesh through
+``make_array_from_callback`` + memory-mapped shard files — load never needs a full host
+copy either (the "retarget a different PS topology" load path, mllib:696-725).
+
 Improvement over the reference: ``train_state`` records (iteration, words_processed), so a
 ``numIterations`` run is resumable mid-way — the reference is all-or-nothing (SURVEY §5).
-
-Arrays are gathered to host before writing; a tensorstore/orbax sharded writer can slot in
-behind the same layout for >HBM models.
 """
 
 from __future__ import annotations
@@ -33,7 +46,8 @@ import numpy as np
 
 from glint_word2vec_tpu.config import Word2VecConfig
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+_READABLE_VERSIONS = (1, 2)
 
 
 @dataclasses.dataclass
@@ -108,6 +122,201 @@ def save_model(
         raise
 
 
+def _write_array_shards(dirpath: str, arr) -> List[Dict[str, int]]:
+    """Write the row ranges THIS process owns (replica 0 only) as individual .npy
+    files. ``arr`` is a (possibly multi-process) row-sharded jax.Array; no full-array
+    host materialization happens — each shard's ``.data`` is device-local."""
+    os.makedirs(dirpath, exist_ok=True)
+    written: List[Dict[str, int]] = []
+    for sh in arr.addressable_shards:
+        if sh.replica_id != 0:
+            continue  # rows replicated over the data axis: first replica writes
+        rows = sh.index[0]
+        start = rows.start or 0
+        stop = rows.stop if rows.stop is not None else arr.shape[0]
+        cols = sh.index[1] if len(sh.index) > 1 else slice(None)
+        if (cols.start or 0) != 0 or (cols.stop not in (None, arr.shape[1])):
+            raise ValueError(
+                "row-shards layout requires row sharding (full rows per shard); got "
+                f"column slice {cols} — use the dense layout for other shardings")
+        fname = f"rows-{start:010d}-{stop:010d}.npy"
+        np.save(os.path.join(dirpath, fname), np.asarray(sh.data))
+        written.append({"file": fname, "start": int(start), "stop": int(stop)})
+    return written
+
+
+def save_model_sharded(
+    path: str,
+    words: List[str],
+    counts: np.ndarray,
+    syn0,
+    syn1,
+    config: Word2VecConfig,
+    train_state: Optional[TrainState] = None,
+    vocab_size: Optional[int] = None,
+    vector_size: Optional[int] = None,
+) -> None:
+    """Row-shards save: every process writes its own rows, process 0 writes metadata
+    and swaps the directory into place after a cross-process barrier. Single-process
+    runs degenerate to the same protocol with one writer.
+
+    ``syn0``/``syn1`` are the PADDED sharded jax.Arrays exactly as trained;
+    ``vocab_size``/``vector_size`` record the real extents for readers.
+    """
+    import jax
+
+    bad = [w for w in words if (not w) or ("\n" in w)]
+    if bad:
+        raise ValueError(
+            f"cannot save vocabulary: {len(bad)} token(s) are empty or contain "
+            f"newlines (first: {bad[0]!r}); the words sidecar is newline-delimited")
+    multi = jax.process_count() > 1
+    if multi:
+        from jax.experimental import multihost_utils
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    # deterministic tmp name: all processes write into the SAME staging dir (shared
+    # filesystem contract, like the reference's HDFS target)
+    tmp = os.path.join(parent, f".{os.path.basename(path)}.tmp-sharded")
+    if jax.process_index() == 0:
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+    if multi:
+        multihost_utils.sync_global_devices("glint-ckpt-staged")
+    try:
+        shards_meta = {
+            "syn0": _write_array_shards(os.path.join(tmp, "syn0.shards"), syn0),
+        }
+        if syn1 is not None:
+            shards_meta["syn1"] = _write_array_shards(
+                os.path.join(tmp, "syn1.shards"), syn1)
+        if multi:
+            multihost_utils.sync_global_devices("glint-ckpt-written")
+        if jax.process_index() == 0:
+            with open(os.path.join(tmp, "words"), "w", encoding="utf-8") as f:
+                for w in words:
+                    f.write(w + "\n")
+            np.save(os.path.join(tmp, "counts.npy"),
+                    np.asarray(counts, dtype=np.int64))
+            # merge shard lists written by all processes by listing the directory —
+            # per-process metadata would need a reduce; the filenames carry the ranges
+            meta = {
+                "format_version": FORMAT_VERSION,
+                "framework": "glint_word2vec_tpu",
+                "layout": "row-shards",
+                "vocab_size": int(vocab_size if vocab_size is not None
+                                  else syn0.shape[0]),
+                "vector_size": int(vector_size if vector_size is not None
+                                   else syn0.shape[1]),
+                "padded_vocab": int(syn0.shape[0]),
+                "padded_dim": int(syn0.shape[1]),
+                "config": config.to_dict(),
+                "train_state": (train_state or TrainState(finished=True)).to_dict(),
+            }
+            with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
+                json.dump(meta, f, indent=2)
+            old = None
+            if os.path.exists(path):
+                old = path + ".old-swap"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(path, old)
+            os.rename(tmp, path)
+            if old is not None:
+                shutil.rmtree(old)
+        if multi:
+            multihost_utils.sync_global_devices("glint-ckpt-done")
+    except BaseException:
+        if jax.process_index() == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+class ShardedMatrixReader:
+    """Memory-mapped reader over a ``*.shards/`` directory: row-range reads without
+    assembling the full matrix."""
+
+    def __init__(self, dirpath: str):
+        self.dirpath = dirpath
+        self._spans: List[tuple] = []
+        for fname in sorted(os.listdir(dirpath)):
+            if not fname.startswith("rows-"):
+                continue
+            stem = fname[len("rows-"):-len(".npy")]
+            start, stop = (int(x) for x in stem.split("-"))
+            self._spans.append((start, stop, fname))
+        if not self._spans:
+            raise FileNotFoundError(f"no shard files under {dirpath!r}")
+        self._spans.sort()
+        self.rows = self._spans[-1][1]
+        probe = np.load(os.path.join(dirpath, self._spans[0][2]), mmap_mode="r")
+        self.cols = probe.shape[1]
+        self.dtype = probe.dtype
+        prev = 0
+        for start, stop, _ in self._spans:
+            if start != prev:
+                raise ValueError(
+                    f"shard gap/overlap at row {prev} (next shard starts {start}) "
+                    f"under {dirpath!r}")
+            prev = stop
+
+    def read(self, start: int, stop: int) -> np.ndarray:
+        """Rows [start, stop) assembled from the overlapping shard files (mmap-backed,
+        so only the requested pages are touched)."""
+        out = np.empty((stop - start, self.cols), dtype=self.dtype)
+        for s, e, fname in self._spans:
+            lo, hi = max(start, s), min(stop, e)
+            if lo >= hi:
+                continue
+            m = np.load(os.path.join(self.dirpath, fname), mmap_mode="r")
+            out[lo - start:hi - start] = m[lo - s:hi - s]
+        return out
+
+    def read_all(self) -> np.ndarray:
+        return self.read(0, self.rows)
+
+
+def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int):
+    """Stream a row-shards checkpoint straight onto a target mesh (which may differ
+    from the one that wrote it — the reference's load-onto-new-PS-topology path,
+    mllib:696-725): each device's row block is read from the mmap'd shard files by a
+    ``make_array_from_callback`` callback, zero-padded to the target padded shape.
+    Returns (syn0, syn1) as global jax.Arrays; syn1 is None if not saved."""
+    import jax
+
+    meta_path = os.path.join(path, "metadata.json")
+    with open(meta_path, "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    if meta.get("layout") != "row-shards":
+        raise ValueError(f"{path!r} is not a row-shards checkpoint")
+    V, Dr = meta["vocab_size"], meta["vector_size"]
+
+    def make(name: str):
+        dirpath = os.path.join(path, f"{name}.shards")
+        if not os.path.isdir(dirpath):
+            return None
+        reader = ShardedMatrixReader(dirpath)
+
+        def cb(idx):
+            rows = idx[0]
+            start = rows.start or 0
+            stop = rows.stop if rows.stop is not None else padded_vocab
+            block = np.zeros((stop - start, padded_dim), dtype=np.float32)
+            lo, hi = start, min(stop, V)  # rows beyond the real vocab stay zero
+            if lo < hi:
+                src = reader.read(lo, hi)
+                block[:hi - lo, :min(Dr, padded_dim)] = \
+                    src[:, :min(Dr, padded_dim)]
+            cols = idx[1] if len(idx) > 1 else slice(None)
+            return block[:, cols]
+
+        return jax.make_array_from_callback(
+            (padded_vocab, padded_dim), plan.embedding, cb)
+
+    return make("syn0"), make("syn1")
+
+
 def load_model(path: str) -> Dict[str, Any]:
     """Read a saved model directory. Returns dict with words, counts, syn0, syn1 (may be
     None), config, train_state. Mirrors the reference's load contract (mllib:710-725:
@@ -118,14 +327,22 @@ def load_model(path: str) -> Dict[str, Any]:
     with open(meta_path, "r", encoding="utf-8") as f:
         meta = json.load(f)
     version = meta.get("format_version")
-    if version != FORMAT_VERSION:
+    if version not in _READABLE_VERSIONS:
         raise ValueError(f"unsupported checkpoint format_version {version}")
     with open(os.path.join(path, "words"), "r", encoding="utf-8") as f:
         words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
     counts = np.load(os.path.join(path, "counts.npy"))
-    syn0 = np.load(os.path.join(path, "syn0.npy"))
-    syn1_path = os.path.join(path, "syn1.npy")
-    syn1 = np.load(syn1_path) if os.path.exists(syn1_path) else None
+    if meta.get("layout") == "row-shards":
+        V, Dr = meta["vocab_size"], meta["vector_size"]
+        syn0 = ShardedMatrixReader(
+            os.path.join(path, "syn0.shards")).read(0, V)[:, :Dr]
+        s1dir = os.path.join(path, "syn1.shards")
+        syn1 = (ShardedMatrixReader(s1dir).read(0, V)[:, :Dr]
+                if os.path.isdir(s1dir) else None)
+    else:
+        syn0 = np.load(os.path.join(path, "syn0.npy"))
+        syn1_path = os.path.join(path, "syn1.npy")
+        syn1 = np.load(syn1_path) if os.path.exists(syn1_path) else None
     if syn0.shape[0] != len(words):
         raise ValueError(
             f"words sidecar has {len(words)} entries but syn0 has {syn0.shape[0]} rows")

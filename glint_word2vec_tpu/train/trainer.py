@@ -95,18 +95,32 @@ class Trainer:
         # replicated device copies, passed into the jitted chunk as ARGUMENTS every
         # dispatch — closure-captured constants take a catastrophically slow gather
         # path on TPU (see ops/prng.py)
-        self._table_prob = jax.device_put(self.table.prob, plan.replicated)
-        self._table_alias = jax.device_put(self.table.alias, plan.replicated)
+        from glint_word2vec_tpu.parallel.distributed import put_global
+        tabs = put_global(plan.replicated,
+                          {"prob": np.asarray(self.table.prob),
+                           "alias": np.asarray(self.table.alias)})
+        self._table_prob = tabs["prob"]
+        self._table_alias = tabs["alias"]
         self._root_key = jax.random.key(config.seed)
         if params is None:
             params = init_embeddings(
                 self.padded_vocab, config.vector_size,
                 jax.random.fold_in(self._root_key, 0),
                 dtype=jnp.dtype(config.param_dtype))
-        params = self._pad_params(params)
-        self.params = jax.tree.map(
-            lambda a: jax.device_put(a, plan.embedding), params,
-            is_leaf=lambda x: not isinstance(x, tuple))
+        from glint_word2vec_tpu.parallel.distributed import put_global
+        if (isinstance(params.syn0, jax.Array)
+                and params.syn0.shape == (self.padded_vocab, self.padded_dim)
+                and params.syn0.sharding.is_equivalent_to(plan.embedding, 2)):
+            # already padded and placed (e.g. streamed in by load_params_into_plan)
+            self.params = params
+        else:
+            params = self._pad_params(params)
+            placed = put_global(
+                plan.embedding,
+                # every process computes the same deterministic init (same key), so
+                # the callback assembly is consistent across hosts
+                {"syn0": np.asarray(params.syn0), "syn1": np.asarray(params.syn1)})
+            self.params = EmbeddingPair(placed["syn0"], placed["syn1"])
         self.state = train_state or TrainState()
         self._chunk_sharding = plan.batch_stacked
         self.global_step = 0
@@ -249,11 +263,11 @@ class Trainer:
                              for name, arr in pending[0].items()}
                     pending.append(dummy)
                     pending_words.append(pending_words[-1])
-                stacked = {
-                    name: jax.device_put(
-                        np.stack([b[name] for b in pending]), self._chunk_sharding)
-                    for name in pending[0]
-                }
+                from glint_word2vec_tpu.parallel.distributed import put_global
+                stacked = put_global(
+                    self._chunk_sharding,
+                    {name: np.stack([b[name] for b in pending])
+                     for name in pending[0]})
                 alphas = np.asarray([
                     alpha_schedule(float(w), total_words, cfg.learning_rate,
                                    cfg.min_alpha_factor)
@@ -335,9 +349,18 @@ class Trainer:
                              syn1=self.params.syn1[:V, :D])
 
     def save_checkpoint(self, path: str) -> None:
-        p = self.unpadded_params()
-        save_model(
-            path, self.vocab.words, self.vocab.counts,
-            np.asarray(p.syn0), np.asarray(p.syn1),
-            self.config, self.state)
+        from glint_word2vec_tpu.parallel.distributed import is_multiprocess
+        if self.config.sharded_checkpoint or is_multiprocess():
+            # row-shards layout: each process writes its own rows, no host gather
+            from glint_word2vec_tpu.train.checkpoint import save_model_sharded
+            save_model_sharded(
+                path, self.vocab.words, self.vocab.counts,
+                self.params.syn0, self.params.syn1, self.config, self.state,
+                vocab_size=self.vocab.size, vector_size=self.config.vector_size)
+        else:
+            p = self.unpadded_params()
+            save_model(
+                path, self.vocab.words, self.vocab.counts,
+                np.asarray(p.syn0), np.asarray(p.syn1),
+                self.config, self.state)
         logger.info("checkpoint saved to %s at step %d", path, self.global_step)

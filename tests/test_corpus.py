@@ -1,0 +1,80 @@
+"""Streaming-ingestion tests (data/corpus.py): the bounded-RAM analog of the
+reference's unbounded RDD input (mllib:310-345)."""
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.data.corpus import (
+    EncodedCorpus,
+    TokenFileCorpus,
+    encode_corpus,
+)
+from glint_word2vec_tpu.data.pipeline import encode_sentences, epoch_batches
+from glint_word2vec_tpu.data.vocab import build_vocab
+
+
+@pytest.fixture()
+def corpus_file(tmp_path):
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(200)]
+    lines = []
+    for _ in range(400):
+        n = rng.integers(1, 60)
+        lines.append(" ".join(words[j] for j in rng.integers(0, 200, n)))
+    lines.append("")          # blank line: skipped
+    lines.append("oovonly1 oovonly2")  # all-OOV after min_count: dropped on encode
+    p = tmp_path / "corpus.txt"
+    p.write_text("\n".join(lines), encoding="utf-8")
+    return str(p)
+
+
+def test_token_file_corpus_is_reiterable(corpus_file):
+    c = TokenFileCorpus(corpus_file)
+    first = sum(1 for _ in c)
+    second = sum(1 for _ in c)
+    assert first == second == 401  # blank line dropped, oov line still tokenized
+
+
+def test_encode_corpus_matches_in_memory_encoding(corpus_file, tmp_path):
+    c = TokenFileCorpus(corpus_file)
+    vocab = build_vocab(c, min_count=2)
+    want = encode_sentences(list(c), vocab, max_sentence_length=37)
+    got = encode_corpus(c, vocab, str(tmp_path / "enc"), max_sentence_length=37)
+    assert len(got) == len(want)
+    assert got.total_tokens == sum(len(s) for s in want)
+    for i in (0, 1, len(want) // 2, len(want) - 1):
+        np.testing.assert_array_equal(got[i], want[i])
+    # reopen from disk
+    re = EncodedCorpus(str(tmp_path / "enc"))
+    assert len(re) == len(want)
+    np.testing.assert_array_equal(re[3], want[3])
+
+
+def test_epoch_batches_identical_from_mmap_and_list(corpus_file, tmp_path):
+    """The trainer consumes batches; disk-backed and in-RAM sentences must produce
+    bit-identical streams (same seed → same training run)."""
+    c = TokenFileCorpus(corpus_file)
+    vocab = build_vocab(c, min_count=2)
+    in_ram = encode_sentences(list(c), vocab, max_sentence_length=100)
+    on_disk = encode_corpus(c, vocab, str(tmp_path / "enc2"), max_sentence_length=100)
+    kw = dict(pairs_per_batch=512, window=3, subsample_ratio=1e-3, seed=5, iteration=1)
+    for a, b in zip(epoch_batches(in_ram, vocab, **kw),
+                    epoch_batches(on_disk, vocab, **kw)):
+        np.testing.assert_array_equal(a.centers, b.centers)
+        np.testing.assert_array_equal(a.contexts, b.contexts)
+        np.testing.assert_array_equal(a.mask, b.mask)
+
+
+def test_fit_from_file_corpus(corpus_file, tmp_path):
+    """End-to-end: Word2Vec.fit streams a TokenFileCorpus through a disk cache."""
+    from glint_word2vec_tpu.models.estimator import Word2Vec
+
+    model = Word2Vec(vector_size=16, min_count=2, pairs_per_batch=256,
+                     num_iterations=1, window=3, seed=1).fit(
+        TokenFileCorpus(corpus_file),
+        encode_cache_dir=str(tmp_path / "cache"))
+    assert model.vector_size == 16
+    v = model.transform("w0")
+    assert v.shape == (16,) and np.isfinite(v).all()
+    # the cache dir holds the encoded shards
+    assert (tmp_path / "cache" / "tokens.bin").exists()

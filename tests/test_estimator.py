@@ -186,3 +186,19 @@ def test_lane_padding_columns_stay_zero():
     full = np.asarray(tr.params.syn0)
     assert full.shape[1] == 128
     np.testing.assert_array_equal(full[:, 20:], 0.0)
+
+
+def test_compat_batch_size_maps_to_device_batch():
+    """setBatchSize/setNumPartitions map to pairs_per_batch (their product, the
+    reference's concurrent-pair count, mllib:417-429) — with a perf warning for tiny
+    batches. Untouched knobs keep the TPU-efficient config default."""
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        cfg = (ServerSideGlintWord2Vec()
+               .setBatchSize(50).setNumPartitions(4).to_config())
+    assert cfg.pairs_per_batch == 200
+    assert any("pairs_per_batch" in str(r.message) for r in rec)
+
+    default_cfg = ServerSideGlintWord2Vec().to_config()
+    from glint_word2vec_tpu.config import Word2VecConfig
+    assert default_cfg.pairs_per_batch == Word2VecConfig().pairs_per_batch

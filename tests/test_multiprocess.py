@@ -1,0 +1,83 @@
+"""Two-process distributed training test — the scaled-down analog of a multi-host
+TPU pod run (G1/G8 replacement; reference boots its PS cluster across executors,
+mllib:354-360).
+
+Spawns 2 coordinated JAX processes, each with 4 virtual CPU devices, builds ONE global
+(2, 4) mesh spanning both, and trains end-to-end through the Trainer with the
+replicated-pipeline input feed (parallel/distributed.py). Both processes must finish in
+lockstep and agree bit-for-bit on the final (replicated-checksummed) parameters.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from glint_word2vec_tpu.parallel.distributed import initialize, is_multiprocess
+pid = int(sys.argv[1]); port = sys.argv[2]
+initialize(coordinator_address="127.0.0.1:" + port, num_processes=2, process_id=pid)
+assert is_multiprocess()
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+import numpy as np
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.pipeline import encode_sentences
+from glint_word2vec_tpu.data.vocab import build_vocab
+from glint_word2vec_tpu.parallel.mesh import make_mesh
+from glint_word2vec_tpu.train.trainer import Trainer
+
+rng = np.random.default_rng(0)
+words = [f"w{i}" for i in range(64)]
+sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
+vocab = build_vocab(sentences, min_count=1)
+cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
+                     num_iterations=2, window=3, negatives=3, negative_pool=16,
+                     steps_per_dispatch=2, seed=7)
+plan = make_mesh(2, 4)   # spans both processes: 8 global devices
+trainer = Trainer(cfg, vocab, plan=plan)
+assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
+encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
+trainer.fit(encoded)
+
+import jax.numpy as jnp
+checksum = float(jax.jit(lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(
+    trainer.params))
+assert np.isfinite(checksum)
+print(f"CHECKSUM {checksum:.10e} steps {trainer.global_step} "
+      f"pairs {trainer.pairs_trained:.0f}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), port],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\nstdout:{out}\nstderr:{err[-3000:]}"
+        outs.append(out)
+    lines = [next(ln for ln in o.splitlines() if ln.startswith("CHECKSUM"))
+             for o in outs]
+    assert lines[0] == lines[1], f"processes disagree: {lines}"
