@@ -36,6 +36,18 @@ import numpy as np
 from glint_word2vec_tpu.data.vocab import Vocabulary
 
 
+def stream_rng(seed: int, iteration: int, shard: int) -> np.random.Generator:
+    """The batch stream's RNG: deterministic per (seed, iteration, shard) — the analog
+    of the reference's XORShift reseed ``seed ^ ((idx+1)<<16) ^ ((-k-1)<<8)``
+    (mllib:372,382). The uint64 mask is the single place the host pipeline normalizes
+    user seeds (compat setSeed accepts the reference's full Long surface, and
+    SeedSequence rejects negative entropy); the device-side negative sampler applies
+    its own uint32 mask in the trainer — the two streams are independent by design."""
+    return np.random.default_rng(
+        np.random.SeedSequence(entropy=seed & 0xFFFFFFFFFFFFFFFF,
+                               spawn_key=(iteration, shard)))
+
+
 def encode_sentences(
     sentences: Iterable[Sequence[str]],
     vocab: Vocabulary,
@@ -275,8 +287,7 @@ def epoch_batches(
     and processed in ~``block_words``-word blocks, each block fully vectorized
     (:func:`_block_pairs`) — the host must outrun a TPU consuming millions of pairs/s.
     """
-    rng = np.random.default_rng(
-        np.random.SeedSequence(entropy=seed, spawn_key=(iteration, shard)))
+    rng = stream_rng(seed, iteration, shard)
     keep = keep_probabilities(vocab.counts, vocab.train_words_count, subsample_ratio)
     order = np.arange(shard, len(sentences), num_shards)
     if shuffle:
@@ -394,8 +405,7 @@ def epoch_batches_cbow(
 ) -> Iterator[CbowBatch]:
     """CBOW analog of :func:`epoch_batches`: fixed-shape [B, 2·window] context batches."""
     B = int(pairs_per_batch)
-    rng = np.random.default_rng(
-        np.random.SeedSequence(entropy=seed, spawn_key=(iteration, shard)))
+    rng = stream_rng(seed, iteration, shard)
     keep = keep_probabilities(vocab.counts, vocab.train_words_count, subsample_ratio)
     order = np.arange(shard, len(sentences), num_shards)
     if shuffle:

@@ -82,9 +82,22 @@ class Word2Vec:
         sentences: Iterable[Sequence[str]],
         plan: Optional[MeshPlan] = None,
         checkpoint_every_steps: Optional[int] = None,
+        encode_cache_dir: Optional[str] = None,
     ) -> Word2VecModel:
         """Resume an interrupted run from a mid-training checkpoint (capability the
-        reference lacks — its runs are all-or-nothing, SURVEY §5)."""
+        reference lacks — its runs are all-or-nothing, SURVEY §5). Resume is
+        exact-step: the checkpoint records the deterministic batch-stream position
+        (``TrainState.batches_done``), so already-trained batches of the interrupted
+        iteration are skipped, not replayed.
+
+        ``sentences`` may be raw token sequences or an already-encoded
+        :class:`..data.corpus.EncodedCorpus`. ``encode_cache_dir`` behaves as in
+        :meth:`fit`: if it already holds an encoded corpus it is reused as-is
+        (the common resume case — no re-encoding pass), otherwise the sentences are
+        streamed into it; either way training reads memory-mapped shards."""
+        import os
+
+        from glint_word2vec_tpu.data.corpus import EncodedCorpus, encode_corpus
         from glint_word2vec_tpu.ops.sgns import EmbeddingPair
         from glint_word2vec_tpu.train.checkpoint import load_model
 
@@ -92,18 +105,27 @@ class Word2Vec:
         cfg: Word2VecConfig = data["config"]
         state = data["train_state"]
         vocab = Vocabulary.from_words_and_counts(data["words"], data["counts"])
-        sentences = sentences if isinstance(sentences, (list, tuple)) else list(sentences)
-        encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
+        if isinstance(sentences, EncodedCorpus):
+            encoded = sentences
+        elif encode_cache_dir is not None:
+            if os.path.exists(os.path.join(encode_cache_dir, "meta.json")):
+                encoded = EncodedCorpus(encode_cache_dir)
+            else:
+                encoded = encode_corpus(
+                    sentences, vocab, encode_cache_dir, cfg.max_sentence_length)
+        else:
+            if iter(sentences) is sentences:
+                sentences = list(sentences)
+            encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
         if data["syn1"] is None:
             raise ValueError("checkpoint has no syn1; cannot resume training")
         import jax.numpy as jnp
         params = EmbeddingPair(jnp.asarray(data["syn0"]), jnp.asarray(data["syn1"]))
         trainer = Trainer(cfg, vocab, plan=plan, params=params, train_state=state)
         if not state.finished:
-            # restart at the recorded iteration (iteration granularity: batches within the
-            # current iteration are re-run; exact-step resume needs the stream offset too).
-            # Keep checkpointing alive across the resumed run — default to the cadence that
-            # presumably produced this checkpoint.
+            # pass checkpoint_every_steps explicitly to keep periodic checkpointing
+            # alive across the resumed run — the cadence is a fit() argument, not
+            # persisted in the checkpoint, so it cannot be inherited
             trainer.fit(encoded, checkpoint_path=checkpoint_path,
                         checkpoint_every_steps=checkpoint_every_steps)
         out = trainer.unpadded_params()

@@ -46,25 +46,40 @@ import numpy as np
 
 from glint_word2vec_tpu.config import Word2VecConfig
 
-FORMAT_VERSION = 2
+# Per-layout format stamps: the dense .npy layout is unchanged since round 1 and stays
+# at 1 (readers pinned to 1 keep working); the row-shards layout introduced the bump.
+DENSE_FORMAT_VERSION = 1
+SHARDED_FORMAT_VERSION = 2
 _READABLE_VERSIONS = (1, 2)
 
 
 @dataclasses.dataclass
 class TrainState:
     """Mid-training progress: which iteration we are in and how many (subsampled) words
-    the lr-decay clock has consumed (mllib:405-413 semantics)."""
+    the lr-decay clock has consumed (mllib:405-413 semantics).
+
+    ``global_step`` is the hash-PRNG counter (ops/prng.py): persisting it keeps the
+    (seed, counter) negative-sample lattice from repeating across a checkpoint resume.
+    ``batches_done`` is the number of batches of the *current* iteration already trained —
+    the deterministic batch-stream position that makes resume exact-step (the stream is a
+    pure function of (seed, iteration, shard), so skipping ``batches_done`` batches
+    reproduces the interrupted run's position).
+    """
 
     iteration: int = 1
     words_processed: int = 0
     finished: bool = False
+    global_step: int = 0
+    batches_done: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "TrainState":
-        return cls(**{k: d[k] for k in ("iteration", "words_processed", "finished")
+        return cls(**{k: d[k]
+                      for k in ("iteration", "words_processed", "finished",
+                                "global_step", "batches_done")
                       if k in d})
 
 
@@ -101,7 +116,7 @@ def save_model(
         if syn1 is not None:
             np.save(os.path.join(tmp, "syn1.npy"), np.asarray(syn1, dtype=np.float32))
         meta = {
-            "format_version": FORMAT_VERSION,
+            "format_version": DENSE_FORMAT_VERSION,
             "framework": "glint_word2vec_tpu",
             "vocab_size": int(syn0.shape[0]),
             "vector_size": int(syn0.shape[1]),
@@ -122,12 +137,12 @@ def save_model(
         raise
 
 
-def _write_array_shards(dirpath: str, arr) -> List[Dict[str, int]]:
+def _write_array_shards(dirpath: str, arr) -> None:
     """Write the row ranges THIS process owns (replica 0 only) as individual .npy
     files. ``arr`` is a (possibly multi-process) row-sharded jax.Array; no full-array
-    host materialization happens — each shard's ``.data`` is device-local."""
+    host materialization happens — each shard's ``.data`` is device-local. The
+    filenames carry the row ranges; readers list the directory (no manifest)."""
     os.makedirs(dirpath, exist_ok=True)
-    written: List[Dict[str, int]] = []
     for sh in arr.addressable_shards:
         if sh.replica_id != 0:
             continue  # rows replicated over the data axis: first replica writes
@@ -141,8 +156,6 @@ def _write_array_shards(dirpath: str, arr) -> List[Dict[str, int]]:
                 f"column slice {cols} — use the dense layout for other shardings")
         fname = f"rows-{start:010d}-{stop:010d}.npy"
         np.save(os.path.join(dirpath, fname), np.asarray(sh.data))
-        written.append({"file": fname, "start": int(start), "stop": int(stop)})
-    return written
 
 
 def save_model_sharded(
@@ -162,6 +175,15 @@ def save_model_sharded(
 
     ``syn0``/``syn1`` are the PADDED sharded jax.Arrays exactly as trained;
     ``vocab_size``/``vector_size`` record the real extents for readers.
+
+    Failure model (shared fate, like every barrier in a SPMD program): if any process
+    raises between the barriers, the survivors block in ``sync_global_devices`` until the
+    JAX coordination service detects the dead process and fails the whole job — there is
+    no per-process timeout here by design, because a partial save must never be swapped
+    into place. Garbage left in ``.tmp-sharded`` by a failed attempt is reclaimed by the
+    next save: process 0 rmtree's the staging dir before the first barrier. The atomic
+    ``os.rename`` swap means an existing checkpoint at ``path`` is never corrupted by a
+    mid-save crash.
     """
     import jax
 
@@ -185,12 +207,11 @@ def save_model_sharded(
     if multi:
         multihost_utils.sync_global_devices("glint-ckpt-staged")
     try:
-        shards_meta = {
-            "syn0": _write_array_shards(os.path.join(tmp, "syn0.shards"), syn0),
-        }
+        # shard lists are NOT collected into metadata: readers list the directory, and
+        # the filenames carry the row ranges (a cross-process reduce would buy nothing)
+        _write_array_shards(os.path.join(tmp, "syn0.shards"), syn0)
         if syn1 is not None:
-            shards_meta["syn1"] = _write_array_shards(
-                os.path.join(tmp, "syn1.shards"), syn1)
+            _write_array_shards(os.path.join(tmp, "syn1.shards"), syn1)
         if multi:
             multihost_utils.sync_global_devices("glint-ckpt-written")
         if jax.process_index() == 0:
@@ -199,10 +220,8 @@ def save_model_sharded(
                     f.write(w + "\n")
             np.save(os.path.join(tmp, "counts.npy"),
                     np.asarray(counts, dtype=np.int64))
-            # merge shard lists written by all processes by listing the directory —
-            # per-process metadata would need a reduce; the filenames carry the ranges
             meta = {
-                "format_version": FORMAT_VERSION,
+                "format_version": SHARDED_FORMAT_VERSION,
                 "framework": "glint_word2vec_tpu",
                 "layout": "row-shards",
                 "vocab_size": int(vocab_size if vocab_size is not None

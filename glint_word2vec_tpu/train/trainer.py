@@ -41,6 +41,7 @@ from glint_word2vec_tpu.ops.sgns import (
     sgns_step_core,
     sgns_step_shared_core,
 )
+from glint_word2vec_tpu.parallel.distributed import put_global
 from glint_word2vec_tpu.parallel.mesh import MeshPlan, make_mesh, pad_vocab_for_sharding
 from glint_word2vec_tpu.train.checkpoint import TrainState, save_model
 
@@ -95,7 +96,6 @@ class Trainer:
         # replicated device copies, passed into the jitted chunk as ARGUMENTS every
         # dispatch — closure-captured constants take a catastrophically slow gather
         # path on TPU (see ops/prng.py)
-        from glint_word2vec_tpu.parallel.distributed import put_global
         tabs = put_global(plan.replicated,
                           {"prob": np.asarray(self.table.prob),
                            "alias": np.asarray(self.table.alias)})
@@ -107,7 +107,6 @@ class Trainer:
                 self.padded_vocab, config.vector_size,
                 jax.random.fold_in(self._root_key, 0),
                 dtype=jnp.dtype(config.param_dtype))
-        from glint_word2vec_tpu.parallel.distributed import put_global
         if (isinstance(params.syn0, jax.Array)
                 and params.syn0.shape == (self.padded_vocab, self.padded_dim)
                 and params.syn0.sharding.is_equivalent_to(plan.embedding, 2)):
@@ -123,7 +122,9 @@ class Trainer:
             self.params = EmbeddingPair(placed["syn0"], placed["syn1"])
         self.state = train_state or TrainState()
         self._chunk_sharding = plan.batch_stacked
-        self.global_step = 0
+        # resume continues the (seed, counter) PRNG lattice where the checkpoint left
+        # off — restarting at 0 would redraw the run's opening negative-sample stream
+        self.global_step = self.state.global_step
         self.pairs_trained = 0.0  # real (unmasked) pairs dispatched over this run
         self.heartbeats: List[HeartbeatRecord] = []
         self._step_fn = self._build_step()
@@ -145,7 +146,9 @@ class Trainer:
         cfg = self.config
         compute_dtype = jnp.dtype(cfg.compute_dtype)
         plan = self.plan
-        seed = cfg.seed & 0xFFFFFFFF
+        # np.uint32 (not a Python int): any negative or 64-bit seed masked to 32 bits
+        # lands in [2^31, 2^32), which jnp.asarray rejects under int32 canonicalization
+        seed = np.uint32(cfg.seed & 0xFFFFFFFF)
         if cfg.use_pallas:
             from glint_word2vec_tpu.ops.pallas import sgns_kernel  # deferred import
             if len(plan.mesh.devices.flat) > 1:
@@ -159,6 +162,12 @@ class Trainer:
             inner = sgns_kernel.make_pallas_sgns_step(
                 cfg.negatives, cfg.negative_pool, cfg.sigmoid_mode, compute_dtype,
                 interpret=jax.default_backend() == "cpu")
+            if cfg.negative_pool <= 0:
+                logger.warning(
+                    "use_pallas=True requires a shared negative pool; negative_pool=0 "
+                    "(per-pair negatives) is substituted with a 64-negative shared pool "
+                    "— a different objective estimator. Set negative_pool explicitly "
+                    "to silence this.")
             pool = cfg.negative_pool if cfg.negative_pool > 0 else 64
             neg_shape = lambda K, B: (K, pool)  # noqa: E731
         elif cfg.negative_pool > 0 and not cfg.cbow:
@@ -247,14 +256,19 @@ class Trainer:
 
         K = max(1, cfg.steps_per_dispatch)
         start_iter = self.state.iteration
+        # exact-step resume: the batch stream is deterministic per (seed, iteration,
+        # shard), so skipping the recorded number of already-trained batches reproduces
+        # the interrupted run's position instead of replaying the whole iteration
+        skip_batches = self.state.batches_done if not self.state.finished else 0
         for k in range(start_iter, cfg.num_iterations + 1):
             prev_words = (k - 1) * train_words
             pending: List[dict] = []
             pending_words: List[int] = []
+            batches_in_iter = skip_batches if k == start_iter else 0
 
             def dispatch():
                 nonlocal pending, pending_words, pending_metrics
-                nonlocal last_log_time, last_log_step
+                nonlocal last_log_time, last_log_step, batches_in_iter
                 if not pending:
                     return
                 real = len(pending)
@@ -263,7 +277,6 @@ class Trainer:
                              for name, arr in pending[0].items()}
                     pending.append(dummy)
                     pending_words.append(pending_words[-1])
-                from glint_word2vec_tpu.parallel.distributed import put_global
                 stacked = put_global(
                     self._chunk_sharding,
                     {name: np.stack([b[name] for b in pending])
@@ -276,11 +289,13 @@ class Trainer:
                     self.params, stacked, np.int32(self.global_step + 1), alphas,
                     self._table_prob, self._table_alias)
                 self.global_step += real
+                batches_in_iter += real
                 real_pairs = sum(float(b["mask"].sum()) for b in pending[:real])
                 pairs_since_log[0] += real_pairs
                 self.pairs_trained += real_pairs
                 self.state = TrainState(
-                    iteration=k, words_processed=int(pending_words[real - 1]))
+                    iteration=k, words_processed=int(pending_words[real - 1]),
+                    global_step=self.global_step, batches_done=batches_in_iter)
 
                 if self.global_step - last_log_step >= cfg.heartbeat_every_steps:
                     # metric fetch forces a device sync; chunked cadence keeps the
@@ -310,7 +325,11 @@ class Trainer:
                         and self.global_step % checkpoint_every_steps < real):
                     self.save_checkpoint(checkpoint_path)
 
+            to_skip = skip_batches if k == start_iter else 0
             for batch in self._batch_stream(sentences, k):
+                if to_skip:  # fast-forward over already-trained batches (exact resume)
+                    to_skip -= 1
+                    continue
                 pending_words.append(prev_words + batch.pop("words_seen"))
                 pending.append(batch)
                 if len(pending) == K:
@@ -320,7 +339,7 @@ class Trainer:
         self.state = TrainState(
             iteration=cfg.num_iterations,
             words_processed=int(cfg.num_iterations * train_words),
-            finished=True)
+            finished=True, global_step=self.global_step)
         if checkpoint_path:
             self.save_checkpoint(checkpoint_path)
         return self.params

@@ -78,3 +78,23 @@ def test_fit_from_file_corpus(corpus_file, tmp_path):
     assert v.shape == (16,) and np.isfinite(v).all()
     # the cache dir holds the encoded shards
     assert (tmp_path / "cache" / "tokens.bin").exists()
+
+
+def test_streaming_resume_reuses_cache(corpus_file, tmp_path):
+    """Word2Vec.resume accepts encode_cache_dir and reuses an existing encoded corpus
+    without a re-encoding pass (VERDICT r2 #8: long-run resume must compose with the
+    long-run ingestion path)."""
+    from glint_word2vec_tpu.models.estimator import Word2Vec
+
+    cache = str(tmp_path / "cache")
+    ckpt = str(tmp_path / "ckpt")
+    Word2Vec(vector_size=16, min_count=2, pairs_per_batch=256,
+             num_iterations=2, window=3, seed=1).fit(
+        TokenFileCorpus(corpus_file), encode_cache_dir=cache,
+        checkpoint_path=ckpt, checkpoint_every_steps=1)
+    mtime = (tmp_path / "cache" / "tokens.bin").stat().st_mtime_ns
+    resumed = Word2Vec.resume(ckpt, TokenFileCorpus(corpus_file),
+                              encode_cache_dir=cache)
+    assert resumed.train_state.finished
+    # the encoded corpus was reused, not rewritten
+    assert (tmp_path / "cache" / "tokens.bin").stat().st_mtime_ns == mtime
