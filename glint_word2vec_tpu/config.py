@@ -110,6 +110,11 @@ class Word2VecConfig:
                                       # metrics forces a host sync, so at 8k-pair batches a
                                       # word-based cadence would sync nearly every step and
                                       # halve throughput
+    prefetch_chunks: int = 4        # dispatch chunks buffered by the background batch
+                                    # producer thread: host pair-generation overlaps device
+                                    # compute (the reference pipelines one minibatch deep
+                                    # for the same reason, mllib:428-429). 0 = synchronous
+                                    # (producer thread off; debugging aid)
 
     def __post_init__(self) -> None:
         if self.vector_size <= 0:

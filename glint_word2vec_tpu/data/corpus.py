@@ -138,5 +138,16 @@ def encode_corpus(
     np.asarray(offsets, dtype=np.int64).tofile(os.path.join(out_dir, _OFFSETS))
     with open(os.path.join(out_dir, _META), "w", encoding="utf-8") as f:
         json.dump({"n_sentences": len(offsets) - 1, "total_tokens": total,
-                   "max_sentence_length": max_sentence_length}, f)
+                   "max_sentence_length": max_sentence_length,
+                   "vocab_fingerprint": vocab_fingerprint(vocab)}, f)
     return EncodedCorpus(out_dir)
+
+
+def vocab_fingerprint(vocab: Vocabulary) -> str:
+    """Cheap stable fingerprint of a vocabulary: ids encoded under a different vocab
+    are meaningless, so consumers that reuse an encoded dir (resume) verify this."""
+    import zlib
+
+    h = zlib.crc32(("\n".join(vocab.words[:1000])).encode("utf-8"))
+    h = zlib.crc32(("\n".join(vocab.words[-1000:])).encode("utf-8"), h)
+    return f"{vocab.size}-{vocab.train_words_count}-{h:08x}"

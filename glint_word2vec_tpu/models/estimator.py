@@ -91,13 +91,15 @@ class Word2Vec:
         iteration are skipped, not replayed.
 
         ``sentences`` may be raw token sequences or an already-encoded
-        :class:`..data.corpus.EncodedCorpus`. ``encode_cache_dir`` behaves as in
-        :meth:`fit`: if it already holds an encoded corpus it is reused as-is
-        (the common resume case — no re-encoding pass), otherwise the sentences are
-        streamed into it; either way training reads memory-mapped shards."""
+        :class:`..data.corpus.EncodedCorpus`. If ``encode_cache_dir`` already holds an
+        encoded corpus whose vocab fingerprint matches the checkpoint's vocabulary, it
+        is reused as-is (the common resume case — no re-encoding pass, unlike
+        :meth:`fit` which always re-encodes); otherwise the sentences are streamed
+        into it. Either way training reads memory-mapped shards."""
         import os
 
-        from glint_word2vec_tpu.data.corpus import EncodedCorpus, encode_corpus
+        from glint_word2vec_tpu.data.corpus import (
+            EncodedCorpus, encode_corpus, vocab_fingerprint)
         from glint_word2vec_tpu.ops.sgns import EmbeddingPair
         from glint_word2vec_tpu.train.checkpoint import load_model
 
@@ -110,6 +112,14 @@ class Word2Vec:
         elif encode_cache_dir is not None:
             if os.path.exists(os.path.join(encode_cache_dir, "meta.json")):
                 encoded = EncodedCorpus(encode_cache_dir)
+                want = vocab_fingerprint(vocab)
+                got = encoded.meta.get("vocab_fingerprint")
+                if got != want:
+                    raise ValueError(
+                        f"encode_cache_dir {encode_cache_dir!r} was encoded under a "
+                        f"different vocabulary (fingerprint {got} != checkpoint's "
+                        f"{want}); ids would map to the wrong words. Point resume at "
+                        "the cache dir of the interrupted run, or a fresh directory.")
             else:
                 encoded = encode_corpus(
                     sentences, vocab, encode_cache_dir, cfg.max_sentence_length)

@@ -78,19 +78,23 @@ def is_multiprocess() -> bool:
 
 
 def put_global(sharding, host_arrays: Dict[str, np.ndarray]):
-    """Place a dict of full (global-shape) host arrays onto a sharding that may span
-    processes.
+    """Place a dict of full (global-shape) host arrays onto sharding(s) that may span
+    processes. ``sharding`` is either one sharding for every array or a dict keyed
+    like ``host_arrays`` (arrays of different ranks need different specs).
 
     Single-process: plain ``device_put``. Multi-process: every process holds the same
     full host array (see module docstring) and ``make_array_from_callback`` carves out
     exactly the shards its local devices own — the ``make_array_from_process_local_data``
     pattern specialized to the replicated-pipeline feed.
     """
+    def spec(k):
+        return sharding[k] if isinstance(sharding, dict) else sharding
+
     if not is_multiprocess():
-        return {k: jax.device_put(v, sharding) for k, v in host_arrays.items()}
+        return {k: jax.device_put(v, spec(k)) for k, v in host_arrays.items()}
     out = {}
     for k, v in host_arrays.items():
         arr = np.asarray(v)
         out[k] = jax.make_array_from_callback(
-            arr.shape, sharding, lambda idx, a=arr: a[idx])
+            arr.shape, spec(k), lambda idx, a=arr: a[idx])
     return out

@@ -63,6 +63,19 @@ class MeshPlan:
         return NamedSharding(self.mesh, P(None, DATA_AXIS))
 
     @property
+    def pairs_stacked(self) -> NamedSharding:
+        """[K, 2, B] packed (centers, contexts) chunk: scan and stream axes replicated,
+        batch axis split over data. One contiguous transfer per dispatch — through a
+        narrow host→device link (tunnel, DCN feed), per-transfer overhead dominates
+        small puts, so the whole chunk ships as a single array."""
+        return NamedSharding(self.mesh, P(None, None, DATA_AXIS))
+
+    @property
+    def ctx_stacked(self) -> NamedSharding:
+        """[K, B, C] CBOW context chunk: batch axis split over data."""
+        return NamedSharding(self.mesh, P(None, DATA_AXIS, None))
+
+    @property
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 

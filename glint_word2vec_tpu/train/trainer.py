@@ -57,6 +57,80 @@ class HeartbeatRecord:
     pairs_per_sec: float
 
 
+class _threaded_iter:
+    """Run a generator on a background thread with a bounded buffer.
+
+    Exceptions raised by the generator re-raise at the consumer's ``next()``.
+    ``close()`` (also called on garbage collection) stops the producer promptly even
+    if it is blocked on a full buffer.
+    """
+
+    _DONE = object()
+
+    def __init__(self, gen, maxsize: int):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._stop = threading.Event()
+        self._queue_mod = queue
+
+        def put_checked(item) -> bool:
+            """Bounded put that gives up once the consumer signals stop — every put
+            (including the terminal DONE/exception) must be preemptible or an
+            abandoned iterator leaks a blocked producer thread."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def run():
+            try:
+                for item in gen:
+                    if not put_checked(item):
+                        return
+                put_checked(self._DONE)
+            except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+                put_checked(e)
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="glint-batch-producer")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._stop.set()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._stop.set()
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        try:  # unblock a producer waiting on a full queue
+            while True:
+                self._q.get_nowait()
+        except self._queue_mod.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class Trainer:
     """Owns the sharded embedding pair and runs the synchronous SGNS/CBOW loop."""
 
@@ -121,7 +195,17 @@ class Trainer:
                 {"syn0": np.asarray(params.syn0), "syn1": np.asarray(params.syn1)})
             self.params = EmbeddingPair(placed["syn0"], placed["syn1"])
         self.state = train_state or TrainState()
-        self._chunk_sharding = plan.batch_stacked
+        # Chunk transfer layout (see chunk_stream in fit): pairs ride in ONE packed
+        # array per dispatch — through a narrow host→device link the per-transfer
+        # overhead dominates, so fewer/larger puts win. Indices ship as uint16 when the
+        # vocab allows (halves feed bytes; upcast on device is free).
+        self._pair_dtype = np.uint16 if self.padded_vocab <= 65536 else np.int32
+        if config.cbow:
+            self._chunk_shardings = {"centers": plan.batch_stacked,
+                                     "contexts": plan.ctx_stacked,
+                                     "ctx_mask": plan.ctx_stacked}
+        else:
+            self._chunk_shardings = {"pairs": plan.pairs_stacked}
         # resume continues the (seed, counter) PRNG lattice where the checkpoint left
         # off — restarting at 0 would redraw the run's opening negative-sample stream
         self.global_step = self.state.global_step
@@ -204,7 +288,9 @@ class Trainer:
 
             neg_shape = lambda K, B: (K, B, cfg.negatives)  # noqa: E731
 
-        def chunk(params, batches, base_step, alphas, prob, alias):
+        is_cbow = cfg.cbow
+
+        def chunk(params, arrays, meta, base_step, prob, alias):
             # scan over steps_per_dispatch stacked batches in one device dispatch:
             # per-step dispatch/transfer latency (large through a remote-TPU tunnel)
             # would otherwise dominate the ~ms step. Two hard-won TPU constraints
@@ -214,19 +300,37 @@ class Trainer:
             #    before the scan;
             #  - the alias tables enter as jit arguments (prob, alias), never as
             #    closure constants.
+            # Feed-bandwidth constraints (measured through the same tunnel):
+            #  - pairs arrive as ONE packed [K, 2, B] array (possibly uint16);
+            #  - the per-pair mask never ships: batches are prefix-masked by
+            #    construction, so mask_k = (iota < real_k), rebuilt on device from
+            #    the [2, K] meta array (row 0 alphas, row 1 real counts).
+            alphas, reals = meta[0], meta[1]
             K = alphas.shape[0]
-            B = batches["centers"].shape[1]
+            if is_cbow:
+                B = arrays["centers"].shape[1]
+            else:
+                B = arrays["pairs"].shape[2]
             negatives = sample_negatives_hash(
                 prob, alias, seed, base_step, neg_shape(K, B))
+            pos = jnp.arange(B, dtype=jnp.float32)
 
             def body(p, inp):
-                batch, alpha, negs = inp
+                xs, alpha, real, negs = inp
+                mask = (pos < real).astype(jnp.float32)
+                if is_cbow:
+                    batch = {"centers": xs["centers"].astype(jnp.int32),
+                             "contexts": xs["contexts"].astype(jnp.int32),
+                             "ctx_mask": xs["ctx_mask"], "mask": mask}
+                else:
+                    prs = xs["pairs"].astype(jnp.int32)
+                    batch = {"centers": prs[0], "contexts": prs[1], "mask": mask}
                 new_p, metrics = inner(p, batch, negs, alpha)
                 new_p = jax.lax.with_sharding_constraint(
                     new_p, EmbeddingPair(plan.embedding, plan.embedding))
                 return new_p, metrics
 
-            return jax.lax.scan(body, params, (batches, alphas, negatives))
+            return jax.lax.scan(body, params, (arrays, alphas, reals, negatives))
 
         return jax.jit(chunk, donate_argnums=(0,))
 
@@ -249,65 +353,116 @@ class Trainer:
         train_words = expected_kept_words(
             self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
         total_words = float(cfg.num_iterations * train_words + 1)
-        last_log_time = time.perf_counter()
-        last_log_step = self.global_step
-        pairs_since_log = [0.0]  # mutable cell for the dispatch() closure
-        pending_metrics: Optional[StepMetrics] = None
-
         K = max(1, cfg.steps_per_dispatch)
         start_iter = self.state.iteration
         # exact-step resume: the batch stream is deterministic per (seed, iteration,
         # shard), so skipping the recorded number of already-trained batches reproduces
         # the interrupted run's position instead of replaying the whole iteration
         skip_batches = self.state.batches_done if not self.state.finished else 0
-        for k in range(start_iter, cfg.num_iterations + 1):
-            prev_words = (k - 1) * train_words
-            pending: List[dict] = []
-            pending_words: List[int] = []
-            batches_in_iter = skip_batches if k == start_iter else 0
 
-            def dispatch():
-                nonlocal pending, pending_words, pending_metrics
-                nonlocal last_log_time, last_log_step, batches_in_iter
-                if not pending:
-                    return
-                real = len(pending)
-                while len(pending) < K:  # pad to the compiled chunk length, masked out
-                    dummy = {name: np.zeros_like(arr)
-                             for name, arr in pending[0].items()}
-                    pending.append(dummy)
-                    pending_words.append(pending_words[-1])
-                stacked = put_global(
-                    self._chunk_sharding,
-                    {name: np.stack([b[name] for b in pending])
-                     for name in pending[0]})
-                alphas = np.asarray([
-                    alpha_schedule(float(w), total_words, cfg.learning_rate,
-                                   cfg.min_alpha_factor)
-                    for w in pending_words], np.float32)
+        def chunk_stream():
+            """Pure-numpy chunk assembly: batch generation, K-stacking, padding, alpha
+            schedule. No JAX calls — safe to run on the producer thread."""
+            for k in range(start_iter, cfg.num_iterations + 1):
+                prev_words = (k - 1) * train_words
+                pending: List[dict] = []
+                pending_words: List[int] = []
+                batches_in_iter = skip_batches if k == start_iter else 0
+                to_skip = skip_batches if k == start_iter else 0
+
+                def flush():
+                    nonlocal pending, pending_words, batches_in_iter
+                    real = len(pending)
+                    while len(pending) < K:  # pad to the compiled chunk len, masked out
+                        dummy = {name: (0 if name == "real" else np.zeros_like(arr))
+                                 for name, arr in pending[0].items()}
+                        pending.append(dummy)
+                        pending_words.append(pending_words[-1])
+                    reals = np.asarray([b["real"] for b in pending], np.float32)
+                    if cfg.cbow:
+                        arrays = {name: np.stack([b[name] for b in pending])
+                                  for name in ("centers", "contexts", "ctx_mask")}
+                    else:
+                        # one contiguous [K, 2, B] feed array (see _build_step notes)
+                        arrays = {"pairs": np.stack(
+                            [np.stack([b["centers"], b["contexts"]])
+                             for b in pending]).astype(self._pair_dtype)}
+                    alphas = np.asarray([
+                        alpha_schedule(float(w), total_words, cfg.learning_rate,
+                                       cfg.min_alpha_factor)
+                        for w in pending_words], np.float32)
+                    meta = np.stack([alphas, reals])  # [2, K] — rides with the dispatch
+                    # throughput counts real (unmasked) pairs, not padded batch slots
+                    real_pairs = float(reals.sum())
+                    batches_in_iter += real
+                    chunk = dict(
+                        arrays=arrays, meta=meta, real=real, iteration=k,
+                        words_processed=int(pending_words[real - 1]),
+                        batches_done=batches_in_iter, real_pairs=real_pairs)
+                    pending, pending_words = [], []
+                    return chunk
+
+                for batch in self._batch_stream(sentences, k):
+                    if to_skip:  # fast-forward already-trained batches (exact resume)
+                        to_skip -= 1
+                        continue
+                    pending_words.append(prev_words + batch.pop("words_seen"))
+                    pending.append(batch)
+                    if len(pending) == K:
+                        yield flush()
+                if pending:
+                    yield flush()
+
+        # The reference pipelines one minibatch ahead of its RPC round-trips for the
+        # same reason (mllib:428-429): host work must overlap accelerator work. Here a
+        # producer thread keeps a bounded buffer of ready chunks; numpy releases the
+        # GIL in its hot loops, so production genuinely overlaps dispatch.
+        if cfg.prefetch_chunks > 0:
+            chunks = _threaded_iter(chunk_stream(), cfg.prefetch_chunks)
+        else:
+            chunks = chunk_stream()
+
+        last_log_time = time.perf_counter()
+        last_log_step = self.global_step
+        pairs_since_log = 0.0
+        pending_metrics: Optional[StepMetrics] = None
+        self.host_wait_time = 0.0      # fit() blocked on batch production
+        self.dispatch_time = 0.0       # fit() inside transfer + (async) step dispatch
+        chunks = iter(chunks)
+        try:
+            while True:
+                t0 = time.perf_counter()
+                chunk = next(chunks, None)
+                self.host_wait_time += time.perf_counter() - t0
+                if chunk is None:
+                    break
+                t0 = time.perf_counter()
+                stacked = put_global(self._chunk_shardings, chunk["arrays"])
+                real = chunk["real"]
                 self.params, pending_metrics = self._step_fn(
-                    self.params, stacked, np.int32(self.global_step + 1), alphas,
+                    self.params, stacked, chunk["meta"],
+                    np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias)
+                self.dispatch_time += time.perf_counter() - t0
                 self.global_step += real
-                batches_in_iter += real
-                real_pairs = sum(float(b["mask"].sum()) for b in pending[:real])
-                pairs_since_log[0] += real_pairs
-                self.pairs_trained += real_pairs
+                pairs_since_log += chunk["real_pairs"]
+                self.pairs_trained += chunk["real_pairs"]
                 self.state = TrainState(
-                    iteration=k, words_processed=int(pending_words[real - 1]),
-                    global_step=self.global_step, batches_done=batches_in_iter)
+                    iteration=chunk["iteration"],
+                    words_processed=chunk["words_processed"],
+                    global_step=self.global_step,
+                    batches_done=chunk["batches_done"])
 
                 if self.global_step - last_log_step >= cfg.heartbeat_every_steps:
                     # metric fetch forces a device sync; chunked cadence keeps the
                     # async dispatch pipeline full (the reference's every-10k-words
                     # line, mllib:404-413, assumed 50-pair minibatches)
                     now = time.perf_counter()
-                    # throughput counts real (unmasked) pairs, not padded batch slots
-                    pps = pairs_since_log[0] / max(now - last_log_time, 1e-9)
-                    pairs_since_log[0] = 0.0
+                    pps = pairs_since_log / max(now - last_log_time, 1e-9)
+                    pairs_since_log = 0.0
                     rec = HeartbeatRecord(
                         words=self.state.words_processed,
-                        alpha=float(alphas[real - 1]),
+                        alpha=float(chunk["meta"][0, real - 1]),
                         loss=float(pending_metrics.loss[real - 1]),
                         mean_f_pos=float(pending_metrics.mean_f_pos[real - 1]),
                         pairs_per_sec=pps)
@@ -320,21 +475,13 @@ class Trainer:
                         on_heartbeat(rec)
                     last_log_time, last_log_step = now, self.global_step
 
-                pending, pending_words = [], []
                 if (checkpoint_path and checkpoint_every_steps
                         and self.global_step % checkpoint_every_steps < real):
                     self.save_checkpoint(checkpoint_path)
-
-            to_skip = skip_batches if k == start_iter else 0
-            for batch in self._batch_stream(sentences, k):
-                if to_skip:  # fast-forward over already-trained batches (exact resume)
-                    to_skip -= 1
-                    continue
-                pending_words.append(prev_words + batch.pop("words_seen"))
-                pending.append(batch)
-                if len(pending) == K:
-                    dispatch()
-            dispatch()
+        finally:
+            closer = getattr(chunks, "close", None)
+            if closer is not None:
+                closer()
 
         self.state = TrainState(
             iteration=cfg.num_iterations,
@@ -350,15 +497,17 @@ class Trainer:
             pairs_per_batch=cfg.pairs_per_batch, window=cfg.window,
             subsample_ratio=cfg.subsample_ratio, seed=cfg.seed, iteration=iteration,
             shuffle=cfg.shuffle)
+        # batches are prefix-masked by construction (PairBatcher pads only the tail),
+        # so only the real count ships — the device rebuilds mask = (iota < real)
         if cfg.cbow:
             for b in epoch_batches_cbow(sentences, self.vocab, **common):
                 yield {"centers": b.centers, "contexts": b.contexts,
-                       "ctx_mask": b.ctx_mask, "mask": b.mask,
+                       "ctx_mask": b.ctx_mask, "real": b.num_real,
                        "words_seen": b.words_seen}
         else:
             for b in epoch_batches(sentences, self.vocab, **common):
-                yield {"centers": b.centers, "contexts": b.contexts, "mask": b.mask,
-                       "words_seen": b.words_seen}
+                yield {"centers": b.centers, "contexts": b.contexts,
+                       "real": b.num_real_pairs, "words_seen": b.words_seen}
 
     # -- export / persistence ----------------------------------------------------------
 

@@ -98,3 +98,23 @@ def test_streaming_resume_reuses_cache(corpus_file, tmp_path):
     assert resumed.train_state.finished
     # the encoded corpus was reused, not rewritten
     assert (tmp_path / "cache" / "tokens.bin").stat().st_mtime_ns == mtime
+
+
+def test_resume_rejects_foreign_cache(corpus_file, tmp_path):
+    """A cache encoded under a different vocabulary must be rejected, not silently
+    trained on (ids would map to the wrong words)."""
+    import pytest
+    from glint_word2vec_tpu.models.estimator import Word2Vec
+
+    ckpt = str(tmp_path / "ckpt")
+    Word2Vec(vector_size=16, min_count=2, pairs_per_batch=256,
+             num_iterations=2, window=3, seed=1).fit(
+        TokenFileCorpus(corpus_file),
+        checkpoint_path=ckpt, checkpoint_every_steps=1)
+    # a cache built under a DIFFERENT vocab (min_count=1 changes the word set)
+    other = build_vocab(TokenFileCorpus(corpus_file), min_count=1)
+    foreign = str(tmp_path / "foreign")
+    encode_corpus(TokenFileCorpus(corpus_file), other, foreign)
+    with pytest.raises(ValueError, match="different vocabulary"):
+        Word2Vec.resume(ckpt, TokenFileCorpus(corpus_file),
+                        encode_cache_dir=foreign)
