@@ -1,0 +1,148 @@
+"""ctypes binding for the native pair generator (``native/pairgen.cpp``).
+
+The shared library is built on first use with ``g++`` (no Python headers, no
+pybind11 — plain C ABI) and cached next to the source. Everything degrades
+gracefully: if the toolchain or build is unavailable, :func:`native_available`
+returns False and the pipeline stays on the bit-identical numpy path.
+
+Set ``GLINT_DISABLE_NATIVE=1`` to force the numpy path (e.g. for A/B testing);
+``GLINT_NATIVE_THREADS`` overrides the generator's thread count (default: up to 8,
+capped by the host's cores).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+_ABI_VERSION = 1
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "pairgen.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libpairgen.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           "-o", _LIB + ".tmp", _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        err = getattr(e, "stderr", b"") or b""
+        logger.warning("native pairgen build failed (%s); using the numpy pipeline. "
+                       "stderr: %s", e, err.decode(errors="replace")[-500:])
+        return False
+    os.replace(_LIB + ".tmp", _LIB)
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("GLINT_DISABLE_NATIVE"):
+            _load_failed = True
+            return None
+        needs_build = (not os.path.exists(_LIB)
+                       or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if needs_build and not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            if lib.glint_pairgen_abi_version() != _ABI_VERSION:
+                raise OSError("stale libpairgen.so ABI; rebuild")
+        except OSError:
+            # stale or broken cache: rebuild once
+            if not _build():
+                _load_failed = True
+                return None
+            lib = ctypes.CDLL(_LIB)
+        lib.glint_block_pairs.restype = ctypes.c_int64
+        lib.glint_block_pairs.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,   # tokens, n_tokens
+            ctypes.c_void_p, ctypes.c_int64,   # lengths, n_sents
+            ctypes.c_void_p,                   # keep [V] f32
+            ctypes.c_int32, ctypes.c_int32,    # window, legacy
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,  # seed, iter, shard
+            ctypes.c_uint64,                   # token_base
+            ctypes.c_int32,                    # n_threads
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # out c/x/clock
+            ctypes.c_int64,                    # cap
+            ctypes.c_void_p,                   # out_kept
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def default_threads() -> int:
+    env = os.environ.get("GLINT_NATIVE_THREADS")
+    if env:
+        return max(1, int(env))
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def block_pairs_native(
+    tokens: np.ndarray,
+    lengths: np.ndarray,
+    keep: np.ndarray,
+    window: int,
+    seed: int,
+    iteration: int,
+    shard: int,
+    token_base: int,
+    legacy_asymmetric_window: bool,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Drop-in replacement for ``pipeline._block_pairs`` (same stream, bit-identical).
+
+    The caller is the pipeline's producer thread; the C++ side fans out over
+    sentence ranges and releases the GIL for the whole call (ctypes does)."""
+    lib = _load()
+    assert lib is not None, "call native_available() first"
+    N = int(tokens.shape[0])
+    empty = (np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0, np.int64), 0)
+    if N == 0:
+        return empty
+    tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    keep = np.ascontiguousarray(keep, dtype=np.float32)
+    cap = N * max(2 * window - 2, 1)
+    centers = np.empty(cap, np.int32)
+    contexts = np.empty(cap, np.int32)
+    clock = np.empty(cap, np.int64)
+    kept = ctypes.c_int64(0)
+    n = lib.glint_block_pairs(
+        tokens.ctypes.data, N,
+        lengths.ctypes.data, int(lengths.shape[0]),
+        keep.ctypes.data,
+        int(window), int(bool(legacy_asymmetric_window)),
+        ctypes.c_uint32(seed & 0xFFFFFFFF), ctypes.c_uint32(iteration & 0xFFFFFFFF),
+        ctypes.c_uint32(shard & 0xFFFFFFFF),
+        ctypes.c_uint64(token_base),
+        default_threads(),
+        centers.ctypes.data, contexts.ctypes.data, clock.ctypes.data,
+        cap, ctypes.byref(kept))
+    if n < 0:  # cannot happen under the documented cap bound; belt and braces
+        raise RuntimeError("native pairgen capacity overflow")
+    if n == 0:
+        return (np.empty(0, np.int32), np.empty(0, np.int32),
+                np.empty(0, np.int64), int(kept.value))
+    return centers[:n], contexts[:n], clock[:n], int(kept.value)

@@ -211,27 +211,37 @@ class PairBatcher:
 def _block_pairs(
     tokens: np.ndarray,          # int32 [N] concatenated sentence tokens
     lengths: np.ndarray,         # int64 [S] sentence lengths (sum == N)
-    keep: np.ndarray,            # float64 [V] per-word keep probability
+    keep: np.ndarray,            # float32 [V] per-word keep probability
     window: int,
-    rng: np.random.Generator,
+    seed: int,
+    iteration: int,
+    shard: int,
+    token_base: int,             # raw-token ordinal of this block's first token
     legacy_asymmetric_window: bool,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Subsample + dynamic-window pair generation for a whole block of sentences in a
     handful of vectorized ops (no per-sentence Python loop — the hot host path; a
     per-sentence equivalent exists as :func:`subsample_sentence` +
-    :func:`dynamic_window_pairs` for unit-testing the formulas).
+    :func:`dynamic_window_pairs` for unit-testing the formulas). All randomness is
+    position-keyed (:mod:`.hashrng`), so the native C++ generator
+    (``native/pairgen.cpp``) produces this exact stream in parallel.
 
     Returns (centers, contexts, center_word_index, words_kept) where
     ``center_word_index[p]`` is the kept-word ordinal (within this block) of pair p's
     center — the per-pair lr-decay clock, so downstream batches can credit exactly the
     words consumed *up to each batch* rather than the whole block at once."""
+    from glint_word2vec_tpu.data.hashrng import (
+        STREAM_SUBSAMPLE, STREAM_WINDOW, hash_mod_at, hash_u01_at, stream_base)
+
     N = tokens.shape[0]
     empty = (np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0, np.int64), 0)
     if N == 0:
         return empty
+    ordinals = np.arange(token_base, token_base + N, dtype=np.uint64)
     sent_ids = np.repeat(np.arange(lengths.shape[0]), lengths)
     # subsample the whole block at once (mllib:371-379 semantics)
-    kept_mask = rng.random(N) <= keep[tokens]
+    sub_base = stream_base(seed, STREAM_SUBSAMPLE, iteration, shard)
+    kept_mask = hash_u01_at(sub_base, ordinals) <= keep.astype(np.float32)[tokens]
     toks = tokens[kept_mask]
     sids = sent_ids[kept_mask]
     Nk = toks.shape[0]
@@ -242,8 +252,10 @@ def _block_pairs(
     new_starts = np.concatenate([[0], np.cumsum(new_lengths)])[:-1]
     pos = np.arange(Nk, dtype=np.int64) - new_starts[sids]
     slen = new_lengths[sids]
-    # dynamic window draw (mllib:384-388)
-    b = rng.integers(0, window, size=Nk)
+    # dynamic window draw (mllib:384-388), keyed by the RAW token ordinal so draws
+    # are independent of the subsample outcome of other positions
+    win_base = stream_base(seed, STREAM_WINDOW, iteration, shard)
+    b = hash_mod_at(win_base, ordinals[kept_mask], window)
     left = np.minimum(b, pos)
     right_extent = b if not legacy_asymmetric_window else b - 1
     right = np.clip(np.minimum(right_extent, slen - 1 - pos), 0, None)
@@ -276,6 +288,7 @@ def epoch_batches(
     legacy_asymmetric_window: bool = True,
     flush_last: bool = True,
     block_words: int = 1_000_000,
+    backend: str = "auto",   # "auto" | "numpy" | "native" (C++ generator if built)
 ) -> Iterator[PairBatch]:
     """One iteration's stream of fixed-shape pair batches for one data shard.
 
@@ -285,15 +298,26 @@ def epoch_batches(
 
     Sentences are round-robin assigned to shards (the analog of repartition, mllib:345)
     and processed in ~``block_words``-word blocks, each block fully vectorized
-    (:func:`_block_pairs`) — the host must outrun a TPU consuming millions of pairs/s.
+    (:func:`_block_pairs`) or handed to the multithreaded native generator
+    (``native/pairgen.cpp``, bit-identical stream) — the host must outrun a TPU
+    consuming millions of pairs/s.
     """
+    if backend == "auto":
+        from glint_word2vec_tpu.data.native import native_available
+        use_native = native_available()
+    else:
+        use_native = backend == "native"
+    if use_native:
+        from glint_word2vec_tpu.data.native import block_pairs_native
     rng = stream_rng(seed, iteration, shard)
-    keep = keep_probabilities(vocab.counts, vocab.train_words_count, subsample_ratio)
+    keep = keep_probabilities(
+        vocab.counts, vocab.train_words_count, subsample_ratio).astype(np.float32)
     order = np.arange(shard, len(sentences), num_shards)
     if shuffle:
         rng.shuffle(order)
     batcher = PairBatcher(pairs_per_batch, num_streams=3)
     words_base = 0   # kept words fully consumed in prior blocks
+    token_base = 0   # raw tokens consumed in prior blocks (position-key base)
     words_seen = 0
 
     def block_iter():
@@ -312,8 +336,11 @@ def epoch_batches(
     for block in block_iter():
         tokens = np.concatenate(block) if len(block) > 1 else block[0]
         lengths = np.fromiter((s.shape[0] for s in block), np.int64, len(block))
-        c, x, clock, kept = _block_pairs(
-            tokens, lengths, keep, window, rng, legacy_asymmetric_window)
+        gen = block_pairs_native if use_native else _block_pairs
+        c, x, clock, kept = gen(
+            tokens, lengths, keep, window, seed, iteration, shard, token_base,
+            legacy_asymmetric_window)
+        token_base += int(tokens.shape[0])
         # The reference counts *subsampled* words into its decay clock (mllib:414); the
         # per-pair clock credits words as their pairs are actually emitted, so alpha
         # advances per batch, not per block.

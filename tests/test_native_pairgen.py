@@ -1,0 +1,82 @@
+"""Native C++ pair generator: bit-equivalence with the numpy pipeline, determinism,
+thread-count independence. The stream contract lives in data/hashrng.py; the C++ side
+must reproduce it exactly or silently corrupt training — hence bit-level assertions."""
+
+import os
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.data.native import (
+    block_pairs_native, native_available)
+from glint_word2vec_tpu.data.pipeline import _block_pairs, epoch_batches
+from glint_word2vec_tpu.data.vocab import Vocabulary
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native pairgen not built (no g++?)")
+
+
+def _rand_block(rng, n_sent=200, maxlen=60, V=5000):
+    lens = rng.integers(1, maxlen + 1, n_sent).astype(np.int64)
+    tokens = rng.integers(0, V, lens.sum()).astype(np.int32)
+    keep = np.minimum(rng.random(V) + 0.2, 1.0).astype(np.float32)
+    return tokens, lens, keep
+
+
+@pytest.mark.parametrize("window,legacy", [(5, True), (5, False), (1, True), (12, True)])
+def test_bit_identical_to_numpy(window, legacy):
+    rng = np.random.default_rng(3)
+    tokens, lens, keep = _rand_block(rng)
+    for seed, it, shard, tb in [(1, 1, 0, 0), (99, 4, 3, 2**33 + 17)]:
+        a = _block_pairs(tokens, lens, keep, window, seed, it, shard, tb, legacy)
+        b = block_pairs_native(tokens, lens, keep, window, seed, it, shard, tb, legacy)
+        for i in range(3):
+            np.testing.assert_array_equal(a[i], b[i])
+        assert a[3] == b[3]
+
+
+def test_thread_count_does_not_change_stream(monkeypatch):
+    rng = np.random.default_rng(4)
+    tokens, lens, keep = _rand_block(rng, n_sent=500)
+    outs = []
+    for n in ("1", "3", "7"):
+        monkeypatch.setenv("GLINT_NATIVE_THREADS", n)
+        outs.append(block_pairs_native(tokens, lens, keep, 5, 2, 1, 0, 0, True))
+    for o in outs[1:]:
+        for i in range(3):
+            np.testing.assert_array_equal(outs[0][i], o[i])
+
+
+def test_epoch_batches_backends_agree():
+    rng = np.random.default_rng(5)
+    V = 2000
+    sentences = [rng.integers(0, V, rng.integers(2, 50)).astype(np.int32)
+                 for _ in range(300)]
+    counts = np.bincount(np.concatenate(sentences), minlength=V) + 1
+    vocab = Vocabulary.from_words_and_counts([f"w{i}" for i in range(V)], counts)
+    kw = dict(pairs_per_batch=512, window=4, subsample_ratio=1e-3, seed=11,
+              iteration=2)
+    for a, b in zip(epoch_batches(sentences, vocab, backend="numpy", **kw),
+                    epoch_batches(sentences, vocab, backend="native", **kw)):
+        np.testing.assert_array_equal(a.centers, b.centers)
+        np.testing.assert_array_equal(a.contexts, b.contexts)
+        np.testing.assert_array_equal(a.mask, b.mask)
+        assert a.words_seen == b.words_seen
+
+
+def test_stream_independent_of_block_size():
+    """Position-keyed randomness: the pair stream must not depend on how sentences
+    are grouped into blocks (block_words is a perf knob, not a semantic one)."""
+    rng = np.random.default_rng(6)
+    V = 1000
+    sentences = [rng.integers(0, V, 30).astype(np.int32) for _ in range(200)]
+    counts = np.bincount(np.concatenate(sentences), minlength=V) + 1
+    vocab = Vocabulary.from_words_and_counts([f"w{i}" for i in range(V)], counts)
+    kw = dict(pairs_per_batch=256, window=3, subsample_ratio=1e-2, seed=2,
+              iteration=1, shuffle=False)
+    a = list(epoch_batches(sentences, vocab, block_words=100, **kw))
+    b = list(epoch_batches(sentences, vocab, block_words=10**9, **kw))
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x.centers, y.centers)
+        np.testing.assert_array_equal(x.contexts, y.contexts)
