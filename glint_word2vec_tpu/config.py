@@ -110,7 +110,7 @@ class Word2VecConfig:
                                       # metrics forces a host sync, so at 8k-pair batches a
                                       # word-based cadence would sync nearly every step and
                                       # halve throughput
-    prefetch_chunks: int = 4        # dispatch chunks buffered by the background batch
+    prefetch_chunks: int = 8        # dispatch chunks buffered by the background batch
                                     # producer thread: host pair-generation overlaps device
                                     # compute (the reference pipelines one minibatch deep
                                     # for the same reason, mllib:428-429). 0 = synchronous

@@ -45,29 +45,38 @@ class Word2VecModel:
         plan: Optional[MeshPlan] = None,
         train_state: Optional["ckpt.TrainState"] = None,
     ):
-        if syn0.shape[0] != vocab.size:
+        Vp = (pad_vocab_for_sharding(vocab.size, plan.num_model)
+              if plan is not None else vocab.size)
+        if syn0.shape[0] not in (vocab.size, Vp):
             raise ValueError(
                 f"syn0 has {syn0.shape[0]} rows but vocabulary has {vocab.size} words")
         self.vocab = vocab
         self.config = config or Word2VecConfig(vector_size=int(syn0.shape[1]))
         self.plan = plan
         self.train_state = train_state
-        syn0 = jnp.asarray(syn0)
-        syn1 = jnp.asarray(syn1) if syn1 is not None else None
         if plan is not None:
             # Row-sharding needs rows % num_model == 0: pad with zero rows (zero norm →
             # cosine 0 and explicitly masked out of top-k), the model-ops analog of the
-            # trainer's pad_vocab_for_sharding.
-            Vp = pad_vocab_for_sharding(vocab.size, plan.num_model)
-            pad = Vp - vocab.size
-            if pad:
-                zeros = jnp.zeros((pad, syn0.shape[1]), syn0.dtype)
-                syn0 = jnp.concatenate([syn0, zeros])
+            # trainer's pad_vocab_for_sharding. Arrays that arrive already padded AND
+            # placed (the streaming load_params_into_plan path) are used as-is — no
+            # host round-trip.
+            placed = (isinstance(syn0, jax.Array) and syn0.shape[0] == Vp
+                      and syn0.sharding.is_equivalent_to(plan.embedding, 2))
+            if not placed:
+                syn0 = jnp.asarray(syn0)
+                syn1 = jnp.asarray(syn1) if syn1 is not None else None
+                pad = Vp - syn0.shape[0]
+                if pad:
+                    zeros = jnp.zeros((pad, syn0.shape[1]), syn0.dtype)
+                    syn0 = jnp.concatenate([syn0, zeros])
+                    if syn1 is not None:
+                        syn1 = jnp.concatenate([syn1, zeros])
+                syn0 = jax.device_put(syn0, plan.embedding)
                 if syn1 is not None:
-                    syn1 = jnp.concatenate([syn1, zeros])
-            syn0 = jax.device_put(syn0, plan.embedding)
-            if syn1 is not None:
-                syn1 = jax.device_put(syn1, plan.embedding)
+                    syn1 = jax.device_put(syn1, plan.embedding)
+        else:
+            syn0 = jnp.asarray(syn0)
+            syn1 = jnp.asarray(syn1) if syn1 is not None else None
         self._full0 = syn0
         self._full1 = syn1
         self._norms: Optional[jax.Array] = None
@@ -277,7 +286,24 @@ class Word2VecModel:
     def load(cls, path: str, plan: Optional[MeshPlan] = None) -> "Word2VecModel":
         """Load a saved model; ``plan`` retargets the arrays onto a different mesh — the
         analog of the reference's load-onto-different-PS-topology overloads
-        (mllib:696-725, ml:584-599)."""
+        (mllib:696-725, ml:584-599).
+
+        With a ``plan``, a row-shards checkpoint streams each device's row block
+        straight from the mmap'd shard files onto the target mesh
+        (:func:`..train.checkpoint.load_params_into_plan`) — the full [V, D] matrices
+        never materialize on any single host, so model ops (transform/find_synonyms)
+        work at vocabularies that exceed one host's memory."""
+        if plan is not None:
+            header = ckpt.load_model_header(path)
+            if header["layout"] == "row-shards":
+                vocab = Vocabulary.from_words_and_counts(
+                    header["words"], header["counts"])
+                Vp = pad_vocab_for_sharding(vocab.size, plan.num_model)
+                syn0, syn1 = ckpt.load_params_into_plan(
+                    path, plan, Vp, header["vector_size"])
+                return cls(vocab=vocab, syn0=syn0, syn1=syn1,
+                           config=header["config"], plan=plan,
+                           train_state=header["train_state"])
         data = ckpt.load_model(path)
         vocab = Vocabulary.from_words_and_counts(data["words"], data["counts"])
         return cls(

@@ -336,6 +336,33 @@ def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int):
     return make("syn0"), make("syn1")
 
 
+def load_model_header(path: str) -> Dict[str, Any]:
+    """Read everything EXCEPT the matrices: metadata, words sidecar, counts. This is
+    the cheap half of the reference's load contract (the ``/words`` read + params
+    metadata, mllib:714-715, ml:514-519) — used by the sharded model-load path so the
+    [V, D] matrices never materialize on one host."""
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no metadata.json under {path!r}")
+    with open(meta_path, "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    version = meta.get("format_version")
+    if version not in _READABLE_VERSIONS:
+        raise ValueError(f"unsupported checkpoint format_version {version}")
+    with open(os.path.join(path, "words"), "r", encoding="utf-8") as f:
+        words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+    counts = np.load(os.path.join(path, "counts.npy"))
+    return {
+        "words": words,
+        "counts": counts,
+        "layout": meta.get("layout", "dense"),
+        "vocab_size": meta.get("vocab_size", len(words)),
+        "vector_size": meta.get("vector_size"),
+        "config": Word2VecConfig.from_dict(meta["config"]),
+        "train_state": TrainState.from_dict(meta.get("train_state", {})),
+    }
+
+
 def load_model(path: str) -> Dict[str, Any]:
     """Read a saved model directory. Returns dict with words, counts, syn0, syn1 (may be
     None), config, train_state. Mirrors the reference's load contract (mllib:710-725:

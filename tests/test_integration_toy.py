@@ -128,3 +128,17 @@ def test_to_local(model):
     words, mat = model.to_local()
     assert mat.shape == (model.num_words, 100)
     assert "wien" in words
+
+
+@pytest.mark.slow
+def test_semantic_gates_bfloat16(corpus):
+    """Both reference gates hold with bf16-STORED embeddings (the measured fast path:
+    rows are 768 B instead of 1536 B and the step is row-byte-bound, bench.py). This is
+    the quality evidence behind offering param_dtype="bfloat16"; f32 stays the default."""
+    m = Word2Vec(**FIT, param_dtype="bfloat16", compute_dtype="bfloat16").fit(corpus)
+    syns = dict(m.find_synonyms("österreich", 10))
+    assert "wien" in syns and syns["wien"] > 0.9
+    vecs = m.transform_sentences([["österreich"], ["deutschland"],
+                                  ["wien"], ["berlin"]])
+    res = dict(m.find_synonyms(vecs[2] - vecs[0] + vecs[1], 10))
+    assert "berlin" in res and res["berlin"] > 0.9

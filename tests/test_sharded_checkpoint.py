@@ -110,3 +110,51 @@ def test_feasibility_10m_shapes():
     per_shard_bytes = shape.shape[0] // ways * shape.shape[1] * 4
     assert per_shard_bytes < 2 * 1024 ** 3
     assert shape.shape[0] * shape.shape[1] * 4 > 12 * 1024 ** 3  # dense would be >12 GB
+
+
+def test_model_load_sharded_no_dense_copy(trained, monkeypatch):
+    """Word2VecModel.load(path, plan=...) on a row-shards checkpoint must stream
+    through load_params_into_plan — the dense load_model path (which materializes
+    [V, D] on host, prohibitive at the 10M x 300 north star) must never run."""
+    trainer, vocab, cfg, path = trained
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+    from glint_word2vec_tpu.train import checkpoint as ckpt
+
+    def boom(_path):
+        raise AssertionError("dense load_model must not be called on the sharded path")
+
+    monkeypatch.setattr(ckpt, "load_model", boom)
+    plan2 = make_mesh(1, 8)  # different topology than the 2x4 writer
+    model = Word2VecModel.load(path, plan=plan2)
+    assert model._full0.sharding.is_equivalent_to(plan2.embedding, 2)
+    assert model._full0.shape[0] % 8 == 0
+
+    # model ops run on the sharded arrays
+    want = np.asarray(trainer.unpadded_params().syn0)
+    got = model.pull(list(range(vocab.size)))
+    np.testing.assert_array_equal(got, want[:, :cfg.vector_size])
+    w = vocab.words[0]
+    syns = model.find_synonyms(w, 3)
+    assert len(syns) == 3 and all(s != w for s, _ in syns)
+    # padded rows are masked out of top-k: no index >= vocab.size can surface
+    allv = model.find_synonyms(np.asarray(want[0]), vocab.size)
+    assert all(s in vocab.words for s, _ in allv)
+
+
+def test_model_load_dense_checkpoint_with_plan(tmp_path):
+    """Dense checkpoints still load (and get placed) when a plan is given."""
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+    sents = _small_corpus(60)
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=8, min_count=1, pairs_per_batch=64,
+                         num_iterations=1, window=2, negatives=2, negative_pool=8,
+                         steps_per_dispatch=2, seed=5)
+    trainer = Trainer(cfg, vocab, plan=make_mesh(1, 1))
+    trainer.fit(encode_sentences(sents, vocab, cfg.max_sentence_length))
+    path = str(tmp_path / "dense")
+    trainer.save_checkpoint(path)
+    plan = make_mesh(2, 4)
+    model = Word2VecModel.load(path, plan=plan)
+    assert model._full0.sharding.is_equivalent_to(plan.embedding, 2)
+    np.testing.assert_allclose(
+        model.pull([0, 1]), np.asarray(trainer.unpadded_params().syn0)[:2], rtol=1e-6)
