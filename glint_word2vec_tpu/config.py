@@ -115,6 +115,13 @@ class Word2VecConfig:
                                     # compute (the reference pipelines one minibatch deep
                                     # for the same reason, mllib:428-429). 0 = synchronous
                                     # (producer thread off; debugging aid)
+    shard_input: bool = True        # multi-process runs: each process generates only its
+                                    # own sentence shard (the repartition analog,
+                                    # mllib:345) and per-round allgathers assemble the
+                                    # global batch — host pipeline work scales 1/N with
+                                    # hosts. False = every process regenerates the full
+                                    # stream (zero-coordination fallback). Skip-gram only;
+                                    # CBOW multi-process stays on the replicated feed.
 
     def __post_init__(self) -> None:
         if self.vector_size <= 0:

@@ -293,6 +293,7 @@ class Word2VecModel:
         (:func:`..train.checkpoint.load_params_into_plan`) — the full [V, D] matrices
         never materialize on any single host, so model ops (transform/find_synonyms)
         work at vocabularies that exceed one host's memory."""
+        header = None
         if plan is not None:
             header = ckpt.load_model_header(path)
             if header["layout"] == "row-shards":
@@ -304,7 +305,7 @@ class Word2VecModel:
                 return cls(vocab=vocab, syn0=syn0, syn1=syn1,
                            config=header["config"], plan=plan,
                            train_state=header["train_state"])
-        data = ckpt.load_model(path)
+        data = ckpt.load_model(path, header=header)
         vocab = Vocabulary.from_words_and_counts(data["words"], data["counts"])
         return cls(
             vocab=vocab,

@@ -7,16 +7,16 @@ device mesh: ``jax.distributed.initialize`` wires the coordination service, trai
 collectives ride ICI/DCN inside the jitted step (GSPMD), and only the per-host input
 feed crosses the host boundary.
 
-Input-feed strategy (deliberate, documented tradeoff): every process runs the SAME
-deterministic host pipeline (same seed → identical global batch stream) and each device
-picks its own rows out of the global batch via :func:`put_global`'s callback. This is
-redundant host work, but it is exactly correct, needs zero cross-host coordination, and
-keeps every process in lockstep by construction — there is no "process 3 ran out of
-batches one step early" deadlock class at all. The per-host pipeline feeds ~1M pairs/s
-while one v5e chip consumes ~7M pairs/s, so host redundancy is not the binding
-constraint; pipeline speed is, and that is a separate (native-loader) workstream.
-Sentence-sharded pipelines remain available through ``epoch_batches(shard=,
-num_shards=)`` for users who accept the coordination burden.
+Input-feed strategy: by default (``config.shard_input=True``) each process generates
+only its own 1/N of the sentence stream — ``epoch_batches(shard=process_index,
+num_shards=process_count)``, the repartition analog (mllib:345) — and one
+``process_allgather`` per dispatch round assembles the identical global batch on every
+process (``Trainer._fit_sharded``: the gather rides the device interconnect; word-clock
+deltas travel with it so every process computes identical alphas, and per-process alive
+flags give deadlock-free lockstep when streams end unevenly). Host pipeline work
+therefore scales 1/N with hosts. ``shard_input=False`` selects the zero-coordination
+fallback: every process regenerates the full stream and :func:`put_global` carves out
+its devices' rows — redundant host work, no collectives outside the step.
 
 Launch contract (one command per host, mirroring ``jax.distributed`` conventions):
 

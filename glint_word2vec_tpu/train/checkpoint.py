@@ -64,6 +64,11 @@ class TrainState:
     the deterministic batch-stream position that makes resume exact-step (the stream is a
     pure function of (seed, iteration, shard), so skipping ``batches_done`` batches
     reproduces the interrupted run's position).
+
+    ``shard_progress`` (sharded-input multi-process runs only) records the per-process
+    stream positions ``[[iteration, batches_done], ...]`` indexed by process id — each
+    process's local stream advances at its own rate, so one (iteration, batches_done)
+    pair cannot describe all of them. None on single-process / replicated-feed runs.
     """
 
     iteration: int = 1
@@ -71,6 +76,7 @@ class TrainState:
     finished: bool = False
     global_step: int = 0
     batches_done: int = 0
+    shard_progress: Optional[List[List[int]]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -79,7 +85,7 @@ class TrainState:
     def from_dict(cls, d: Dict[str, Any]) -> "TrainState":
         return cls(**{k: d[k]
                       for k in ("iteration", "words_processed", "finished",
-                                "global_step", "batches_done")
+                                "global_step", "batches_done", "shard_progress")
                       if k in d})
 
 
@@ -363,23 +369,19 @@ def load_model_header(path: str) -> Dict[str, Any]:
     }
 
 
-def load_model(path: str) -> Dict[str, Any]:
+def load_model(path: str, header: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Read a saved model directory. Returns dict with words, counts, syn0, syn1 (may be
     None), config, train_state. Mirrors the reference's load contract (mllib:710-725:
-    read /words in row order, load matrix shards, rebuild model)."""
-    meta_path = os.path.join(path, "metadata.json")
-    if not os.path.exists(meta_path):
-        raise FileNotFoundError(f"no metadata.json under {path!r}")
-    with open(meta_path, "r", encoding="utf-8") as f:
-        meta = json.load(f)
-    version = meta.get("format_version")
-    if version not in _READABLE_VERSIONS:
-        raise ValueError(f"unsupported checkpoint format_version {version}")
-    with open(os.path.join(path, "words"), "r", encoding="utf-8") as f:
-        words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
-    counts = np.load(os.path.join(path, "counts.npy"))
-    if meta.get("layout") == "row-shards":
-        V, Dr = meta["vocab_size"], meta["vector_size"]
+    read /words in row order, load matrix shards, rebuild model).
+
+    ``header``: a prior :func:`load_model_header` result to reuse — callers that
+    already read it (to check the layout) pass it through so the words sidecar and
+    counts are not parsed twice."""
+    if header is None:
+        header = load_model_header(path)
+    words = header["words"]
+    if header["layout"] == "row-shards":
+        V, Dr = header["vocab_size"], header["vector_size"]
         syn0 = ShardedMatrixReader(
             os.path.join(path, "syn0.shards")).read(0, V)[:, :Dr]
         s1dir = os.path.join(path, "syn1.shards")
@@ -394,9 +396,9 @@ def load_model(path: str) -> Dict[str, Any]:
             f"words sidecar has {len(words)} entries but syn0 has {syn0.shape[0]} rows")
     return {
         "words": words,
-        "counts": counts,
+        "counts": header["counts"],
         "syn0": syn0,
         "syn1": syn1,
-        "config": Word2VecConfig.from_dict(meta["config"]),
-        "train_state": TrainState.from_dict(meta.get("train_state", {})),
+        "config": header["config"],
+        "train_state": header["train_state"],
     }

@@ -206,6 +206,18 @@ class Trainer:
                                      "ctx_mask": plan.ctx_stacked}
         else:
             self._chunk_shardings = {"pairs": plan.pairs_stacked}
+        # Sharded input feed (the repartition analog, mllib:345): each process
+        # generates only its 1/N of the sentence stream; the global batch is assembled
+        # from per-process segments by a per-round allgather (see _fit_sharded). The
+        # batch's B axis is composed of N per-process segments, each prefix-masked.
+        self._feed_segments = 1
+        if config.shard_input and jax.process_count() > 1 and not config.cbow:
+            n = jax.process_count()
+            if config.pairs_per_batch % n:
+                raise ValueError(
+                    f"shard_input=True needs pairs_per_batch divisible by the "
+                    f"process count ({config.pairs_per_batch} % {n} != 0)")
+            self._feed_segments = n
         # resume continues the (seed, counter) PRNG lattice where the checkpoint left
         # off — restarting at 0 would redraw the run's opening negative-sample stream
         self.global_step = self.state.global_step
@@ -289,6 +301,7 @@ class Trainer:
             neg_shape = lambda K, B: (K, B, cfg.negatives)  # noqa: E731
 
         is_cbow = cfg.cbow
+        S = self._feed_segments
 
         def chunk(params, arrays, meta, base_step, prob, alias):
             # scan over steps_per_dispatch stacked batches in one device dispatch:
@@ -305,7 +318,10 @@ class Trainer:
             #  - the per-pair mask never ships: batches are prefix-masked by
             #    construction, so mask_k = (iota < real_k), rebuilt on device from
             #    the [2, K] meta array (row 0 alphas, row 1 real counts).
-            alphas, reals = meta[0], meta[1]
+            # meta rows: [0] per-batch alphas; [1:1+S] per-segment real counts. With the
+            # sharded feed (S > 1) the B axis is S contiguous per-process segments, each
+            # prefix-masked on its own, so the mask is rebuilt per segment.
+            alphas, reals = meta[0], meta[1:].T   # [K], [K, S] (scan runs over K)
             K = alphas.shape[0]
             if is_cbow:
                 B = arrays["centers"].shape[1]
@@ -313,11 +329,11 @@ class Trainer:
                 B = arrays["pairs"].shape[2]
             negatives = sample_negatives_hash(
                 prob, alias, seed, base_step, neg_shape(K, B))
-            pos = jnp.arange(B, dtype=jnp.float32)
+            pos = jnp.arange(B // S, dtype=jnp.float32)
 
             def body(p, inp):
                 xs, alpha, real, negs = inp
-                mask = (pos < real).astype(jnp.float32)
+                mask = (pos[None, :] < real[:, None]).astype(jnp.float32).reshape(-1)
                 if is_cbow:
                     batch = {"centers": xs["centers"].astype(jnp.int32),
                              "contexts": xs["contexts"].astype(jnp.int32),
@@ -354,6 +370,18 @@ class Trainer:
             self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
         total_words = float(cfg.num_iterations * train_words + 1)
         K = max(1, cfg.steps_per_dispatch)
+        if self._feed_segments > 1:
+            return self._fit_sharded(
+                sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
+                total_words, K)
+        if self.state.shard_progress is not None and not self.state.finished:
+            # batches_done from a sharded-input run counts B/N-pair local-shard
+            # batches — applying it to the full replicated stream would silently
+            # mis-position the resume
+            raise ValueError(
+                "checkpoint was written by a sharded-input multi-process run "
+                f"({len(self.state.shard_progress)} shards); resume it with the same "
+                "process count and shard_input=True, not on the replicated feed")
         start_iter = self.state.iteration
         # exact-step resume: the batch stream is deterministic per (seed, iteration,
         # shard), so skipping the recorded number of already-trained batches reproduces
@@ -486,6 +514,224 @@ class Trainer:
         self.state = TrainState(
             iteration=cfg.num_iterations,
             words_processed=int(cfg.num_iterations * train_words),
+            finished=True, global_step=self.global_step)
+        if checkpoint_path:
+            self.save_checkpoint(checkpoint_path)
+        return self.params
+
+    def _fit_sharded(
+        self,
+        sentences: Sequence[np.ndarray],
+        checkpoint_path: Optional[str],
+        checkpoint_every_steps: Optional[int],
+        on_heartbeat: Optional[Callable[[HeartbeatRecord], None]],
+        total_words: float,
+        K: int,
+    ) -> EmbeddingPair:
+        """Multi-process fit with the sentence stream sharded across processes — the
+        repartition analog (mllib:345), replacing the every-process-regenerates-
+        everything feed.
+
+        Protocol, one dispatch round at a time (all processes in lockstep):
+
+        1. each process pulls its next LOCAL chunk — K batches of B/N pairs from
+           ``epoch_batches(shard=pid, num_shards=N)`` — off its producer thread;
+           an exhausted process substitutes a zero chunk;
+        2. ONE ``process_allgather`` ships every process's (pairs, real counts, word
+           deltas, alive flag, stream position) to every process — the data rides the
+           fast device interconnect, not a host-side side channel;
+        3. every process deterministically assembles the identical global batch
+           ([K, 2, B]: N contiguous per-process segments), derives the global word
+           clock from the summed deltas, and computes identical per-batch alphas —
+           SPMD consistency holds because every input to the jitted step is a pure
+           function of allgathered values;
+        4. the round ends when the allgathered alive flags are all zero. Processes
+           whose stream ended early keep dispatching fully-masked segments, so there
+           is no "process 3 ran out one step early" deadlock class.
+
+        Unequal per-process streams make a single (iteration, batches_done) pair
+        meaningless, so TrainState.shard_progress records every process's position
+        (from step 2, free) and resume requires the same process count.
+        """
+        import jax
+        from jax.experimental import multihost_utils
+
+        cfg = self.config
+        S = self._feed_segments
+        pid = jax.process_index()
+        B = cfg.pairs_per_batch
+        b_local = B // S
+
+        start_iter = self.state.iteration
+        skip = self.state.batches_done if not self.state.finished else 0
+        if self.state.shard_progress is not None:
+            sp = self.state.shard_progress
+            if len(sp) != S:
+                raise ValueError(
+                    f"checkpoint shard_progress has {len(sp)} entries but this run "
+                    f"has {S} processes; resume sharded-input runs with the same "
+                    "process count")
+            start_iter, skip = int(sp[pid][0]), int(sp[pid][1])
+        elif skip:
+            # a replicated-feed checkpoint's batches_done counts full-B batches of the
+            # unsharded stream — there is no exact mapping onto per-process local
+            # streams, so refuse rather than silently mis-position the resume
+            raise ValueError(
+                "checkpoint was written mid-iteration by a replicated-feed run; it "
+                "cannot be resumed exactly with shard_input=True — resume with "
+                "shard_input=False (or from an iteration-boundary checkpoint)")
+
+        def local_stream():
+            """Local chunks: [K, 2, b_local] pairs + per-batch real counts and word
+            deltas. Pure numpy — safe on the producer thread (the allgather, a device
+            collective, must run on the main thread in identical order everywhere)."""
+            for k in range(start_iter, cfg.num_iterations + 1):
+                pending: List[np.ndarray] = []
+                reals: List[int] = []
+                deltas: List[int] = []
+                prev_ws = 0
+                batches_in_iter = skip if k == start_iter else 0
+                to_skip = skip if k == start_iter else 0
+
+                def flush():
+                    nonlocal pending, reals, deltas, batches_in_iter
+                    real = len(pending)
+                    while len(pending) < K:
+                        pending.append(np.zeros((2, b_local), np.int32))
+                        reals.append(0)
+                        deltas.append(0)
+                    batches_in_iter += real
+                    out = dict(
+                        pairs=np.stack(pending),
+                        reals=np.asarray(reals, np.int32),
+                        deltas=np.asarray(deltas, np.int64),
+                        iteration=k, batches_done=batches_in_iter)
+                    pending, reals, deltas = [], [], []
+                    return out
+
+                for b in epoch_batches(
+                        sentences, self.vocab, pairs_per_batch=b_local,
+                        window=cfg.window, subsample_ratio=cfg.subsample_ratio,
+                        seed=cfg.seed, iteration=k, shard=pid, num_shards=S,
+                        shuffle=cfg.shuffle):
+                    ws = b.words_seen
+                    if to_skip:  # exact resume: fast-forward already-trained batches
+                        to_skip -= 1
+                        prev_ws = ws
+                        continue
+                    pending.append(np.stack([b.centers, b.contexts]))
+                    reals.append(b.num_real_pairs)
+                    deltas.append(ws - prev_ws)
+                    prev_ws = ws
+                    if len(pending) == K:
+                        yield flush()
+                if pending:
+                    yield flush()
+
+        if cfg.prefetch_chunks > 0:
+            chunks = _threaded_iter(local_stream(), cfg.prefetch_chunks)
+        else:
+            chunks = iter(local_stream())
+
+        clock = float(self.state.words_processed)
+        cur_iter, cur_batches = start_iter, skip
+        exhausted = False
+        last_log_time = time.perf_counter()
+        last_log_step = self.global_step
+        pairs_since_log = 0.0
+        pending_metrics: Optional[StepMetrics] = None
+        self.host_wait_time = 0.0
+        self.dispatch_time = 0.0
+        zero_pairs = np.zeros((K, 2, b_local), np.int32)
+        try:
+            while True:
+                t0 = time.perf_counter()
+                local = None if exhausted else next(chunks, None)
+                self.host_wait_time += time.perf_counter() - t0
+                if local is None:
+                    exhausted = True
+                    local = dict(pairs=zero_pairs,
+                                 reals=np.zeros(K, np.int32),
+                                 deltas=np.zeros(K, np.int64),
+                                 iteration=cur_iter, batches_done=cur_batches)
+                else:
+                    cur_iter = local["iteration"]
+                    cur_batches = local["batches_done"]
+
+                t0 = time.perf_counter()
+                g = multihost_utils.process_allgather({
+                    "pairs": local["pairs"],
+                    "reals": local["reals"],
+                    "deltas": local["deltas"],
+                    "alive": np.asarray([0 if exhausted else 1], np.int32),
+                    "prog": np.asarray([cur_iter, cur_batches], np.int64),
+                })  # every leaf gains a leading [S] process axis
+                if int(g["alive"].sum()) == 0:
+                    break
+                reals_all = g["reals"]                              # [S, K]
+                # [S, K, 2, b] -> [K, 2, S, b] -> [K, 2, B]: segment s of every batch
+                # is process s's slice, matching the device-side segment masks
+                pairs_glob = np.transpose(g["pairs"], (1, 2, 0, 3)).reshape(K, 2, B)
+                clocks = clock + np.cumsum(g["deltas"].sum(axis=0))
+                clock = float(clocks[-1])
+                alphas = np.asarray(
+                    [alpha_schedule(float(w), total_words, cfg.learning_rate,
+                                    cfg.min_alpha_factor) for w in clocks], np.float32)
+                meta = np.concatenate(
+                    [alphas[None, :], reals_all.astype(np.float32)], axis=0)
+                # each local stream pads only its final chunk, so per-process real
+                # slots are prefixes and "any segment live" is a prefix too
+                real = int((reals_all > 0).any(axis=0).sum())
+                real_pairs = float(reals_all.sum())
+
+                stacked = put_global(
+                    self._chunk_shardings,
+                    {"pairs": pairs_glob.astype(self._pair_dtype)})
+                self.params, pending_metrics = self._step_fn(
+                    self.params, stacked, meta,
+                    np.int32(self.global_step + 1),
+                    self._table_prob, self._table_alias)
+                self.dispatch_time += time.perf_counter() - t0
+                self.global_step += real
+                pairs_since_log += real_pairs
+                self.pairs_trained += real_pairs
+                self.state = TrainState(
+                    iteration=int(g["prog"][:, 0].min()),
+                    words_processed=int(clock),
+                    global_step=self.global_step,
+                    batches_done=cur_batches,
+                    shard_progress=[[int(a), int(b_)] for a, b_ in g["prog"]])
+
+                if self.global_step - last_log_step >= cfg.heartbeat_every_steps:
+                    now = time.perf_counter()
+                    pps = pairs_since_log / max(now - last_log_time, 1e-9)
+                    pairs_since_log = 0.0
+                    rec = HeartbeatRecord(
+                        words=self.state.words_processed,
+                        alpha=float(meta[0, real - 1]),
+                        loss=float(pending_metrics.loss[real - 1]),
+                        mean_f_pos=float(pending_metrics.mean_f_pos[real - 1]),
+                        pairs_per_sec=pps)
+                    self.heartbeats.append(rec)
+                    logger.info(
+                        "wordCount = %d, alpha = %.6f, loss = %.4f, fPlus = %.4f, "
+                        "pairs/s = %.0f", rec.words, rec.alpha, rec.loss,
+                        rec.mean_f_pos, rec.pairs_per_sec)
+                    if on_heartbeat is not None:
+                        on_heartbeat(rec)
+                    last_log_time, last_log_step = now, self.global_step
+
+                if (checkpoint_path and checkpoint_every_steps
+                        and self.global_step % checkpoint_every_steps < real):
+                    self.save_checkpoint(checkpoint_path)
+        finally:
+            closer = getattr(chunks, "close", None)
+            if closer is not None:
+                closer()
+
+        self.state = TrainState(
+            iteration=cfg.num_iterations,
+            words_processed=int(clock),
             finished=True, global_step=self.global_step)
         if checkpoint_path:
             self.save_checkpoint(checkpoint_path)

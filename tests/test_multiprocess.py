@@ -1,11 +1,18 @@
-"""Two-process distributed training test — the scaled-down analog of a multi-host
+"""Two-process distributed training tests — the scaled-down analog of a multi-host
 TPU pod run (G1/G8 replacement; reference boots its PS cluster across executors,
 mllib:354-360).
 
 Spawns 2 coordinated JAX processes, each with 4 virtual CPU devices, builds ONE global
-(2, 4) mesh spanning both, and trains end-to-end through the Trainer with the
-replicated-pipeline input feed (parallel/distributed.py). Both processes must finish in
-lockstep and agree bit-for-bit on the final (replicated-checksummed) parameters.
+(2, 4) mesh spanning both, and trains end-to-end through the Trainer. Two feed modes
+(parallel/distributed.py):
+
+- sharded (default): each process generates only its sentence shard; per-round
+  allgathers assemble the global batch (the repartition analog, mllib:345);
+- replicated: every process regenerates the full stream.
+
+Both must finish in lockstep and agree bit-for-bit on the final
+(replicated-checksummed) parameters; the sharded mode additionally proves exact-step
+resume from a mid-run sharded checkpoint.
 """
 
 import os
@@ -23,7 +30,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 from glint_word2vec_tpu.parallel.distributed import initialize, is_multiprocess
-pid = int(sys.argv[1]); port = sys.argv[2]
+pid = int(sys.argv[1]); port = sys.argv[2]; mode = sys.argv[3]; workdir = sys.argv[4]
 initialize(coordinator_address="127.0.0.1:" + port, num_processes=2, process_id=pid)
 assert is_multiprocess()
 assert jax.device_count() == 8 and jax.local_device_count() == 4
@@ -41,24 +48,70 @@ sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
 vocab = build_vocab(sentences, min_count=1)
 cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
-                     steps_per_dispatch=2, seed=7)
+                     steps_per_dispatch=2, seed=7,
+                     shard_input=(mode in ("sharded", "resume")))
 plan = make_mesh(2, 4)   # spans both processes: 8 global devices
-trainer = Trainer(cfg, vocab, plan=plan)
-assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
 encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
-trainer.fit(encoded)
 
 import jax.numpy as jnp
-checksum = float(jax.jit(lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(
-    trainer.params))
-assert np.isfinite(checksum)
-print(f"CHECKSUM {checksum:.10e} steps {trainer.global_step} "
-      f"pairs {trainer.pairs_trained:.0f}", flush=True)
+def checksum_of(trainer):
+    return float(jax.jit(lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(
+        trainer.params))
+
+if mode == "resume":
+    # uninterrupted run -> reference params
+    t_ref = Trainer(cfg, vocab, plan=plan)
+    assert t_ref._feed_segments == 2
+    t_ref.fit(encoded)
+    want = checksum_of(t_ref)
+    # interrupted run: checkpoint every 4 global steps, stop after the first save
+    ck = os.path.join(workdir, "ck")
+    t1 = Trainer(cfg, vocab, plan=plan)
+    seen = []
+    class Stop(Exception): pass
+    orig = Trainer.save_checkpoint
+    def save_once(self, path):
+        orig(self, path)
+        seen.append(self.state.global_step)
+        if len(seen) == 1:
+            raise Stop()
+    Trainer.save_checkpoint = save_once
+    try:
+        t1.fit(encoded, checkpoint_path=ck, checkpoint_every_steps=4)
+    except Stop:
+        pass
+    Trainer.save_checkpoint = orig
+    assert seen, "no mid-run checkpoint happened"
+    from glint_word2vec_tpu.train.checkpoint import load_model_header, load_params_into_plan
+    header = load_model_header(ck)
+    st = header["train_state"]
+    assert st.shard_progress is not None and len(st.shard_progress) == 2
+    from glint_word2vec_tpu.parallel.mesh import pad_vocab_for_sharding
+    pv = pad_vocab_for_sharding(vocab.size, plan.num_model)
+    pd = (-(-cfg.vector_size // 128) * 128 if cfg.pad_vector_to_lanes
+          else cfg.vector_size)
+    syn0, syn1 = load_params_into_plan(ck, plan, pv, pd)
+    from glint_word2vec_tpu.ops.sgns import EmbeddingPair
+    t2 = Trainer(cfg, vocab, plan=plan, params=EmbeddingPair(syn0, syn1),
+                 train_state=st)
+    t2.fit(encoded)
+    got = checksum_of(t2)
+    assert abs(got - want) < 1e-4 * max(1.0, abs(want)), (
+        f"resumed params diverge: {got!r} vs {want!r}")
+    print(f"CHECKSUM {got:.10e} steps {t2.global_step}", flush=True)
+else:
+    trainer = Trainer(cfg, vocab, plan=plan)
+    assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
+    assert trainer._feed_segments == (2 if mode == "sharded" else 1)
+    trainer.fit(encoded)
+    checksum = checksum_of(trainer)
+    assert np.isfinite(checksum)
+    print(f"CHECKSUM {checksum:.10e} steps {trainer.global_step} "
+          f"pairs {trainer.pairs_trained:.0f}", flush=True)
 """
 
 
-@pytest.mark.slow
-def test_two_process_training(tmp_path):
+def _run_two(tmp_path, mode):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = str(s.getsockname()[1])
@@ -69,7 +122,7 @@ def test_two_process_training(tmp_path):
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), port],
+            [sys.executable, str(script), str(i), port, mode, str(tmp_path)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
         for i in range(2)
     ]
@@ -81,3 +134,25 @@ def test_two_process_training(tmp_path):
     lines = [next(ln for ln in o.splitlines() if ln.startswith("CHECKSUM"))
              for o in outs]
     assert lines[0] == lines[1], f"processes disagree: {lines}"
+    return lines[0]
+
+
+@pytest.mark.slow
+def test_two_process_training_replicated_feed(tmp_path):
+    _run_two(tmp_path, "replicated")
+
+
+@pytest.mark.slow
+def test_two_process_training_sharded_feed(tmp_path):
+    """Default mode: per-process sentence shards + allgather assembly (mllib:345
+    analog). Cross-process checksum agreement proves SPMD consistency of the
+    assembled batches, alphas, and collective order."""
+    _run_two(tmp_path, "sharded")
+
+
+@pytest.mark.slow
+def test_two_process_sharded_resume(tmp_path):
+    """Interrupt a sharded-feed run at its first mid-run checkpoint, resume from the
+    row-shards checkpoint (per-process stream positions from shard_progress), and
+    match the uninterrupted run's final params exactly."""
+    _run_two(tmp_path, "resume")
