@@ -77,6 +77,11 @@ E2E_B = 65536      # e2e trainer batch: geometry sweep winner (bigger batches
                    # amortize both scatter row cost and feed transfers)
 E2E_K = 32         # e2e steps per dispatch: bigger chunks -> fewer, larger feed
                    # transfers (the tunnel/DCN link rewards both)
+E2E_POOL = 256     # scaled with E2E_B: pool-row load B*n/P must stay ~1300 or the run
+                   # diverges (EVAL.md finding 2); pool 64 at B=65536 trains to NaN.
+                   # subsample 1e-4 in the e2e config for the same reason: without it
+                   # the top Zipf word is ~650 duplicate contexts per 64k batch and
+                   # their summed scatter updates explode (EVAL.md)
 CPU_STEPS = 10
 CPU_B = 8192
 PEAK_FLOPS = 197e12  # v5e bf16 peak / chip
@@ -200,7 +205,8 @@ def bench_e2e() -> float:
     vocab = build_vocab(sentences, min_count=5)
     cfg = Word2VecConfig(
         vector_size=D, min_count=5, pairs_per_batch=E2E_B, num_iterations=1,
-        window=5, negatives=NEG, negative_pool=POOL, steps_per_dispatch=E2E_K, seed=1)
+        window=5, negatives=NEG, negative_pool=E2E_POOL, steps_per_dispatch=E2E_K,
+        seed=1, subsample_ratio=1e-4)
     encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
     trainer = Trainer(cfg, vocab)
     # warm the jit cache on the SAME trainer: one tiny fit would change train state, so
@@ -220,6 +226,9 @@ def bench_e2e() -> float:
         float(jnp.sum(trainer.params.syn0[:128]))
         dt = time.perf_counter() - t0
         rates.append(trainer.pairs_trained / dt)
+        if not np.isfinite(float(jnp.sum(trainer.params.syn0[:1024]))):
+            raise RuntimeError("e2e training diverged (NaN params) — the bench must "
+                               "measure a run that actually learns")
         log(f"  e2e trial {trial}: {trainer.pairs_trained:,.0f} pairs in {dt:.1f}s -> "
             f"{rates[-1]:,.0f} pairs/s  [host-wait {trainer.host_wait_time:.2f}s, "
             f"dispatch {trainer.dispatch_time:.2f}s]")

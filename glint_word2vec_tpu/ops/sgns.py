@@ -249,11 +249,23 @@ def sgns_step_shared_core(
     num_negatives: int,
     sigmoid_mode: str = "exact",
     compute_dtype: jnp.dtype = jnp.float32,
+    duplicate_scaling: bool = False,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """:func:`sgns_step_shared` with the pool supplied by the caller (see
-    :func:`sgns_step_core` for why sampling lives outside the jitted scan)."""
+    :func:`sgns_step_core` for why sampling lives outside the jitted scan).
+
+    ``duplicate_scaling`` extends :func:`sgns_step_core`'s mean-update semantics to
+    this path: each embedding row moves by the MEAN of its per-pair updates instead of
+    their sum — centers/contexts divide by their occurrence count in the batch, and
+    each pool row divides by its number of contributing (valid) pairs times its
+    within-pool multiplicity. This bounds the per-row step at any batch size without
+    subsampling, at the cost of slower differentiation of frequent rows (and, for pool
+    rows, a much smaller effective negative step, since their contribution count is
+    ~B). Frequency subsampling (subsample_ratio ≈ 1e-4) is usually the better fix —
+    see EVAL.md."""
     syn0, syn1 = params
     P = negatives.shape[0]
+    V = syn0.shape[0]
     e_in = syn0[centers].astype(compute_dtype)          # [B, D]
     e_pos = syn1[contexts].astype(compute_dtype)        # [B, D]
     Z = syn1[negatives].astype(compute_dtype)           # [P, D]
@@ -267,11 +279,29 @@ def sgns_step_shared_core(
     g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid
              * (num_negatives / P))
 
-    gp = g_pos[:, None].astype(compute_dtype)
+    if duplicate_scaling:
+        cnt0 = jnp.zeros(V, jnp.float32).at[centers].add(mask)
+        cnt1 = jnp.zeros(V, jnp.float32).at[contexts].add(mask)
+        in_scale = 1.0 / jnp.maximum(cnt0[centers], 1.0)
+        g_pos_in = g_pos * in_scale
+        g_neg_in = g_neg * in_scale[:, None]
+        g_pos_out = g_pos / jnp.maximum(cnt1[contexts], 1.0)
+        # pool row p: mean over its contributing pairs, then divided by how many
+        # pool slots hold the same word (their scatter-adds would otherwise sum)
+        pool_mult = jnp.zeros(V, jnp.float32).at[negatives].add(1.0)[negatives]
+        z_scale = 1.0 / (jnp.maximum(neg_valid.sum(axis=0), 1.0) * pool_mult)
+    else:
+        g_pos_in, g_neg_in, g_pos_out = g_pos, g_neg, g_pos
+        z_scale = None
+
+    gp_in = g_pos_in[:, None].astype(compute_dtype)
+    gn_in = g_neg_in.astype(compute_dtype)
     gn = g_neg.astype(compute_dtype)
-    d_in = gp * e_pos + gn @ Z                           # [B, D] — MXU
-    d_pos = gp * e_in
+    d_in = gp_in * e_pos + gn_in @ Z                     # [B, D] — MXU
+    d_pos = g_pos_out[:, None].astype(compute_dtype) * e_in
     d_Z = gn.T @ e_in                                    # [P, D] — MXU
+    if z_scale is not None:
+        d_Z = d_Z * z_scale[:, None].astype(compute_dtype)
 
     dtype = syn0.dtype
     new_syn0 = syn0.at[centers].add(d_in.astype(dtype))

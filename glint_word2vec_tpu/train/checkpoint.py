@@ -47,10 +47,20 @@ import numpy as np
 from glint_word2vec_tpu.config import Word2VecConfig
 
 # Per-layout format stamps: the dense .npy layout is unchanged since round 1 and stays
-# at 1 (readers pinned to 1 keep working); the row-shards layout introduced the bump.
+# at 1 (readers pinned to 1 keep working); the row-shards layout introduced 2; a
+# checkpoint whose TrainState carries shard_progress (mid-run, sharded-input feed)
+# stamps 3 so that older readers — whose TrainState.from_dict would silently DROP the
+# field and mis-position the resume — refuse it instead.
 DENSE_FORMAT_VERSION = 1
 SHARDED_FORMAT_VERSION = 2
-_READABLE_VERSIONS = (1, 2)
+SHARD_PROGRESS_FORMAT_VERSION = 3
+_READABLE_VERSIONS = (1, 2, 3)
+
+
+def _format_version(base: int, train_state: Optional["TrainState"]) -> int:
+    if train_state is not None and train_state.shard_progress is not None:
+        return SHARD_PROGRESS_FORMAT_VERSION
+    return base
 
 
 @dataclasses.dataclass
@@ -122,7 +132,7 @@ def save_model(
         if syn1 is not None:
             np.save(os.path.join(tmp, "syn1.npy"), np.asarray(syn1, dtype=np.float32))
         meta = {
-            "format_version": DENSE_FORMAT_VERSION,
+            "format_version": _format_version(DENSE_FORMAT_VERSION, train_state),
             "framework": "glint_word2vec_tpu",
             "vocab_size": int(syn0.shape[0]),
             "vector_size": int(syn0.shape[1]),
@@ -227,7 +237,8 @@ def save_model_sharded(
             np.save(os.path.join(tmp, "counts.npy"),
                     np.asarray(counts, dtype=np.int64))
             meta = {
-                "format_version": SHARDED_FORMAT_VERSION,
+                "format_version": _format_version(SHARDED_FORMAT_VERSION,
+                                                  train_state),
                 "framework": "glint_word2vec_tpu",
                 "layout": "row-shards",
                 "vocab_size": int(vocab_size if vocab_size is not None

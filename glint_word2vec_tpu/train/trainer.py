@@ -238,6 +238,42 @@ class Trainer:
 
         return EmbeddingPair(syn0=pad(params.syn0), syn1=pad(params.syn1))
 
+    def _stability_warnings(self) -> None:
+        """Large synchronous batches can diverge through two per-step row-overload
+        channels the reference's tiny async minibatches never hit (measured, EVAL.md):
+
+        - POOL load ``B·n/P``: every pool row absorbs the negative gradient of all B
+          pairs scaled by n/P. B=64k/P=64 (load 5120) trains to NaN at lr 0.025; the
+          same run at P=256 (load 1280) is stable with the best quality of the sweep.
+        - DUPLICATE load ``B·max_word_share``: a frequent word's context occurrences
+          scatter-add summed updates. With no subsampling the top Zipf word is ~1% of
+          pairs (~650 summed updates at B=64k) and training explodes even at small
+          pool loads; frequency subsampling (≈1e-4) or duplicate_scaling bounds it.
+        """
+        cfg = self.config
+        if cfg.duplicate_scaling:
+            return  # mean-update semantics bound both channels by construction
+        pool_load = cfg.pairs_per_batch * cfg.negatives / cfg.negative_pool
+        if pool_load > 2000:
+            logger.warning(
+                "pairs_per_batch*negatives/negative_pool = %.0f > 2000: pool-row "
+                "updates this large can diverge at default learning rates — scale "
+                "negative_pool with the batch (e.g. %d) to keep the load ~1300 "
+                "(EVAL.md)", pool_load,
+                max(64, int(cfg.pairs_per_batch * cfg.negatives / 1300)))
+        from glint_word2vec_tpu.data.pipeline import keep_probabilities
+        keep = keep_probabilities(
+            self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
+        eff = np.asarray(self.vocab.counts, np.float64) * keep
+        dup_load = float(eff.max() / max(eff.sum(), 1.0)) * cfg.pairs_per_batch
+        if dup_load > 300:
+            logger.warning(
+                "expected duplicates of the most frequent word per %d-pair batch "
+                "= %.0f > 300: summed scatter updates this dense can diverge — "
+                "set subsample_ratio (~1e-4, recommended) or "
+                "duplicate_scaling=True, or shrink pairs_per_batch (EVAL.md)",
+                cfg.pairs_per_batch, dup_load)
+
     def _build_step(self) -> Callable:
         cfg = self.config
         compute_dtype = jnp.dtype(cfg.compute_dtype)
@@ -267,15 +303,13 @@ class Trainer:
             pool = cfg.negative_pool if cfg.negative_pool > 0 else 64
             neg_shape = lambda K, B: (K, pool)  # noqa: E731
         elif cfg.negative_pool > 0 and not cfg.cbow:
-            if cfg.duplicate_scaling:
-                logger.warning(
-                    "duplicate_scaling is not implemented for the negative_pool fast "
-                    "path; duplicated rows accumulate summed updates")
+            self._stability_warnings()
 
             def inner(params, batch, negatives, alpha):
                 return sgns_step_shared_core(
                     params, batch["centers"], batch["contexts"], batch["mask"],
-                    negatives, alpha, cfg.negatives, cfg.sigmoid_mode, compute_dtype)
+                    negatives, alpha, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
+                    cfg.duplicate_scaling)
 
             neg_shape = lambda K, B: (K, cfg.negative_pool)  # noqa: E731
         elif cfg.cbow:
@@ -450,12 +484,7 @@ class Trainer:
         else:
             chunks = chunk_stream()
 
-        last_log_time = time.perf_counter()
-        last_log_step = self.global_step
-        pairs_since_log = 0.0
-        pending_metrics: Optional[StepMetrics] = None
-        self.host_wait_time = 0.0      # fit() blocked on batch production
-        self.dispatch_time = 0.0       # fit() inside transfer + (async) step dispatch
+        self._start_run_bookkeeping()
         chunks = iter(chunks)
         try:
             while True:
@@ -467,45 +496,17 @@ class Trainer:
                 t0 = time.perf_counter()
                 stacked = put_global(self._chunk_shardings, chunk["arrays"])
                 real = chunk["real"]
-                self.params, pending_metrics = self._step_fn(
+                self.params, metrics = self._step_fn(
                     self.params, stacked, chunk["meta"],
                     np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias)
                 self.dispatch_time += time.perf_counter() - t0
-                self.global_step += real
-                pairs_since_log += chunk["real_pairs"]
-                self.pairs_trained += chunk["real_pairs"]
-                self.state = TrainState(
-                    iteration=chunk["iteration"],
-                    words_processed=chunk["words_processed"],
-                    global_step=self.global_step,
-                    batches_done=chunk["batches_done"])
-
-                if self.global_step - last_log_step >= cfg.heartbeat_every_steps:
-                    # metric fetch forces a device sync; chunked cadence keeps the
-                    # async dispatch pipeline full (the reference's every-10k-words
-                    # line, mllib:404-413, assumed 50-pair minibatches)
-                    now = time.perf_counter()
-                    pps = pairs_since_log / max(now - last_log_time, 1e-9)
-                    pairs_since_log = 0.0
-                    rec = HeartbeatRecord(
-                        words=self.state.words_processed,
-                        alpha=float(chunk["meta"][0, real - 1]),
-                        loss=float(pending_metrics.loss[real - 1]),
-                        mean_f_pos=float(pending_metrics.mean_f_pos[real - 1]),
-                        pairs_per_sec=pps)
-                    self.heartbeats.append(rec)
-                    logger.info(
-                        "wordCount = %d, alpha = %.6f, loss = %.4f, fPlus = %.4f, "
-                        "pairs/s = %.0f", rec.words, rec.alpha, rec.loss,
-                        rec.mean_f_pos, rec.pairs_per_sec)
-                    if on_heartbeat is not None:
-                        on_heartbeat(rec)
-                    last_log_time, last_log_step = now, self.global_step
-
-                if (checkpoint_path and checkpoint_every_steps
-                        and self.global_step % checkpoint_every_steps < real):
-                    self.save_checkpoint(checkpoint_path)
+                self._finish_round(
+                    real, chunk["real_pairs"], chunk["meta"][0], metrics,
+                    TrainState(iteration=chunk["iteration"],
+                               words_processed=chunk["words_processed"],
+                               batches_done=chunk["batches_done"]),
+                    checkpoint_path, checkpoint_every_steps, on_heartbeat)
         finally:
             closer = getattr(chunks, "close", None)
             if closer is not None:
@@ -518,6 +519,59 @@ class Trainer:
         if checkpoint_path:
             self.save_checkpoint(checkpoint_path)
         return self.params
+
+    def _start_run_bookkeeping(self) -> None:
+        self.host_wait_time = 0.0      # fit() blocked on batch production
+        self.dispatch_time = 0.0       # fit() inside transfer + (async) step dispatch
+        self._last_log_time = time.perf_counter()
+        self._last_log_step = self.global_step
+        self._pairs_since_log = 0.0
+
+    def _finish_round(
+        self,
+        real: int,
+        real_pairs: float,
+        alphas: np.ndarray,            # [K] per-batch alphas of this round
+        metrics: StepMetrics,
+        state: TrainState,             # global_step is filled in here
+        checkpoint_path: Optional[str],
+        checkpoint_every_steps: Optional[int],
+        on_heartbeat: Optional[Callable[[HeartbeatRecord], None]],
+    ) -> None:
+        """Post-dispatch bookkeeping shared by both feed modes: progress counters,
+        heartbeat cadence (the reference's every-10k-words line, mllib:404-413 —
+        fetching device metrics forces a sync, so it runs on a chunked cadence to keep
+        the async dispatch pipeline full), and periodic checkpointing."""
+        import dataclasses as _dc
+
+        cfg = self.config
+        self.global_step += real
+        self._pairs_since_log += real_pairs
+        self.pairs_trained += real_pairs
+        self.state = _dc.replace(state, global_step=self.global_step)
+
+        if self.global_step - self._last_log_step >= cfg.heartbeat_every_steps:
+            now = time.perf_counter()
+            pps = self._pairs_since_log / max(now - self._last_log_time, 1e-9)
+            self._pairs_since_log = 0.0
+            rec = HeartbeatRecord(
+                words=self.state.words_processed,
+                alpha=float(alphas[real - 1]),
+                loss=float(metrics.loss[real - 1]),
+                mean_f_pos=float(metrics.mean_f_pos[real - 1]),
+                pairs_per_sec=pps)
+            self.heartbeats.append(rec)
+            logger.info(
+                "wordCount = %d, alpha = %.6f, loss = %.4f, fPlus = %.4f, "
+                "pairs/s = %.0f", rec.words, rec.alpha, rec.loss,
+                rec.mean_f_pos, rec.pairs_per_sec)
+            if on_heartbeat is not None:
+                on_heartbeat(rec)
+            self._last_log_time, self._last_log_step = now, self.global_step
+
+        if (checkpoint_path and checkpoint_every_steps
+                and self.global_step % checkpoint_every_steps < real):
+            self.save_checkpoint(checkpoint_path)
 
     def _fit_sharded(
         self,
@@ -636,12 +690,7 @@ class Trainer:
         clock = float(self.state.words_processed)
         cur_iter, cur_batches = start_iter, skip
         exhausted = False
-        last_log_time = time.perf_counter()
-        last_log_step = self.global_step
-        pairs_since_log = 0.0
-        pending_metrics: Optional[StepMetrics] = None
-        self.host_wait_time = 0.0
-        self.dispatch_time = 0.0
+        self._start_run_bookkeeping()
         zero_pairs = np.zeros((K, 2, b_local), np.int32)
         try:
             while True:
@@ -687,43 +736,19 @@ class Trainer:
                 stacked = put_global(
                     self._chunk_shardings,
                     {"pairs": pairs_glob.astype(self._pair_dtype)})
-                self.params, pending_metrics = self._step_fn(
+                self.params, metrics = self._step_fn(
                     self.params, stacked, meta,
                     np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias)
                 self.dispatch_time += time.perf_counter() - t0
-                self.global_step += real
-                pairs_since_log += real_pairs
-                self.pairs_trained += real_pairs
-                self.state = TrainState(
-                    iteration=int(g["prog"][:, 0].min()),
-                    words_processed=int(clock),
-                    global_step=self.global_step,
-                    batches_done=cur_batches,
-                    shard_progress=[[int(a), int(b_)] for a, b_ in g["prog"]])
-
-                if self.global_step - last_log_step >= cfg.heartbeat_every_steps:
-                    now = time.perf_counter()
-                    pps = pairs_since_log / max(now - last_log_time, 1e-9)
-                    pairs_since_log = 0.0
-                    rec = HeartbeatRecord(
-                        words=self.state.words_processed,
-                        alpha=float(meta[0, real - 1]),
-                        loss=float(pending_metrics.loss[real - 1]),
-                        mean_f_pos=float(pending_metrics.mean_f_pos[real - 1]),
-                        pairs_per_sec=pps)
-                    self.heartbeats.append(rec)
-                    logger.info(
-                        "wordCount = %d, alpha = %.6f, loss = %.4f, fPlus = %.4f, "
-                        "pairs/s = %.0f", rec.words, rec.alpha, rec.loss,
-                        rec.mean_f_pos, rec.pairs_per_sec)
-                    if on_heartbeat is not None:
-                        on_heartbeat(rec)
-                    last_log_time, last_log_step = now, self.global_step
-
-                if (checkpoint_path and checkpoint_every_steps
-                        and self.global_step % checkpoint_every_steps < real):
-                    self.save_checkpoint(checkpoint_path)
+                self._finish_round(
+                    real, real_pairs, meta[0], metrics,
+                    TrainState(
+                        iteration=int(g["prog"][:, 0].min()),
+                        words_processed=int(clock),
+                        batches_done=cur_batches,
+                        shard_progress=[[int(a), int(b_)] for a, b_ in g["prog"]]),
+                    checkpoint_path, checkpoint_every_steps, on_heartbeat)
         finally:
             closer = getattr(chunks, "close", None)
             if closer is not None:

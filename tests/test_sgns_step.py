@@ -287,3 +287,39 @@ def test_shared_negative_pool_collision_masked():
     f = float(jnp.sum(params.syn0[0] * params.syn1[0]))
     expected_loss = -np.log(1.0 / (1.0 + np.exp(-f)))
     np.testing.assert_allclose(float(m.loss), expected_loss, rtol=1e-5)
+
+
+def test_shared_pool_duplicate_scaling_mean_semantics():
+    """With duplicate_scaling=True on the shared-pool path, R identical pairs move
+    each row exactly as far as ONE pair does (mean of identical updates), bounding the
+    per-row step at any batch size; without it the movement is R-fold (sum)."""
+    import jax.numpy as jnp
+
+    from glint_word2vec_tpu.ops.sgns import EmbeddingPair, sgns_step_shared_core
+
+    V, D, R = 12, 8, 16
+    rng = np.random.default_rng(0)
+    syn0 = jnp.asarray(rng.normal(0, 0.1, (V, D)), jnp.float32)
+    syn1 = jnp.asarray(rng.normal(0, 0.1, (V, D)), jnp.float32)
+    pool = jnp.asarray([7, 8, 9, 7], jnp.int32)  # word 7 twice: multiplicity covered
+    alpha = jnp.float32(0.1)
+
+    def run(B, scaled):
+        centers = jnp.full((B,), 2, jnp.int32)
+        contexts = jnp.full((B,), 5, jnp.int32)
+        mask = jnp.ones((B,), jnp.float32)
+        (s0, s1), _ = sgns_step_shared_core(
+            EmbeddingPair(syn0, syn1), centers, contexts, mask, pool, alpha,
+            num_negatives=2, duplicate_scaling=scaled)
+        return np.asarray(s0), np.asarray(s1)
+
+    one0, one1 = run(1, True)
+    many0, many1 = run(R, True)
+    np.testing.assert_allclose(many0, one0, rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(many1, one1, rtol=2e-5, atol=1e-7)
+
+    # sum semantics (default) moves the center row ~R times as far
+    sum0, _ = run(R, False)
+    d_scaled = np.abs(many0[2] - np.asarray(syn0)[2]).sum()
+    d_sum = np.abs(sum0[2] - np.asarray(syn0)[2]).sum()
+    assert d_sum > 5 * d_scaled

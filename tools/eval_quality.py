@@ -1,0 +1,229 @@
+"""Realistic-scale training quality evaluation (round-3, VERDICT item 3).
+
+The BASELINE real corpora (text8, enwiki) are NOT reachable in this environment: the
+build sandbox has zero network egress and no copy exists on disk (verified by a
+filesystem-wide search). This harness substitutes the closest honest thing: a corpus at
+**text8 scale** (17M words, ~70k effective vocabulary) drawn from a fully-specified
+generative topic model, so embedding quality is *quantitatively* measurable against the
+generator's ground truth instead of eyeballed. When a real text8 is available, drop it
+at --corpus and the same pipeline trains on it unchanged (quality metrics then need an
+external word-sim dataset; the throughput numbers stay comparable).
+
+Generative model (deterministic given --seed):
+    - V_raw word types with Zipf marginals p(r) ∝ 1/(r+10)^1.05 (text8-like head/tail)
+    - the S most frequent types are topic-neutral "stopwords"
+    - every other type r belongs to topic (r mod T); names encode the topic
+      ("t017_w000421") so ground truth travels with the corpus file itself
+    - each sentence draws one topic z; every word is, with prob λ, drawn from the
+      renormalized marginals of topic z's own words, else from the global marginals
+      (stopword/noise mass)
+
+A good embedding must therefore cluster same-topic words. Metrics (ground truth = the
+name prefix; random-vector baseline ≈ 1/T):
+    - purity@10: fraction of a word's 10 cosine-nearest non-stopword neighbors sharing
+      its topic, averaged over 2,000 mid-frequency probe words
+    - margin: mean within-topic cosine minus mean cross-topic cosine over the probes
+
+The run exercises the production ingestion path end-to-end: token FILE →
+TokenFileCorpus → streaming vocab pass → encode_corpus (memory-mapped shards) →
+Trainer → model ops. Prints one JSON line on stdout; progress goes to stderr.
+
+Usage:
+    python tools/eval_quality.py [--words 17000000] [--out /tmp/eval_corpus]
+                                 [--corpus existing.txt] [--dim 100] [--iters 3]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+
+T_TOPICS = 128
+STOPWORDS = 200
+LAMBDA = 0.72
+SENT_LEN = 35
+V_RAW = 90_000   # raw types; min_count=5 trims the tail to ~text8's ~70k
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def topic_of(rank: np.ndarray) -> np.ndarray:
+    """Ground-truth topic of a word rank; stopwords get -1."""
+    return np.where(rank < STOPWORDS, -1, rank % T_TOPICS)
+
+
+def word_names(v: int) -> np.ndarray:
+    ranks = np.arange(v)
+    topics = topic_of(ranks)
+    return np.asarray([
+        f"s_w{r:06d}" if t < 0 else f"t{t:03d}_w{r:06d}"
+        for r, t in zip(ranks, topics)])
+
+
+def generate_corpus(path: str, n_words: int, seed: int) -> None:
+    """Write the topic-model corpus as a token file, one sentence per line."""
+    rng = np.random.default_rng(seed)
+    p = 1.0 / (np.arange(V_RAW) + 10.0) ** 1.05
+    p /= p.sum()
+    names = word_names(V_RAW)
+    topics = topic_of(np.arange(V_RAW))
+    topic_words = [np.where(topics == z)[0] for z in range(T_TOPICS)]
+    topic_probs = [p[w] / p[w].sum() for w in topic_words]
+
+    n_sents = n_words // SENT_LEN
+    t0 = time.perf_counter()
+    with open(path, "w", encoding="utf-8") as f:
+        block = 20_000
+        for start in range(0, n_sents, block):
+            nb = min(block, n_sents - start)
+            z = rng.integers(0, T_TOPICS, nb)
+            words = np.empty((nb, SENT_LEN), np.int32)
+            # global (stopword/noise) draws for every slot, then overwrite the
+            # topic-bound slots per topic group
+            words[:] = rng.choice(V_RAW, size=(nb, SENT_LEN), p=p)
+            from_topic = rng.random((nb, SENT_LEN)) < LAMBDA
+            for zz in np.unique(z):
+                rows = np.where(z == zz)[0]
+                m = from_topic[rows]
+                words[np.repeat(rows, m.sum(1)),
+                      np.concatenate([np.where(r)[0] for r in m])] = rng.choice(
+                    topic_words[zz], size=int(m.sum()), p=topic_probs[zz])
+            lines = [" ".join(names[row]) for row in words]
+            f.write("\n".join(lines) + "\n")
+    log(f"corpus: {n_sents:,} sentences / {n_sents * SENT_LEN:,} words "
+        f"written in {time.perf_counter() - t0:.1f}s -> {path}")
+
+
+def evaluate(model) -> dict:
+    """Topic purity@10 + cosine margin over 2,000 mid-frequency probe words,
+    with a random-embedding baseline for scale."""
+    import jax.numpy as jnp
+
+    words = model.vocab.words
+    ranks_in_vocab = np.asarray(
+        [int(w.split("_w")[1]) for w in words])
+    topics = topic_of(ranks_in_vocab)
+    content = np.where(topics >= 0)[0]
+    # mid-frequency probes: skip the hottest 2k (near-uniform co-occurrence) and the
+    # rarest tail (too few updates)
+    probe_pool = content[(content >= 2000) & (content < 30000)]
+    rng = np.random.default_rng(0)
+    probes = rng.choice(probe_pool, size=min(2000, probe_pool.size), replace=False)
+
+    def purity(emb):
+        e = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
+        q = jnp.asarray(e[probes])
+        base = jnp.asarray(e[content])
+        sims = np.array(q @ base.T)                         # [P, C] (writable copy)
+        # mask self
+        self_pos = {int(c): i for i, c in enumerate(content)}
+        for i, pr in enumerate(probes):
+            sims[i, self_pos[int(pr)]] = -np.inf
+        top = np.argpartition(-sims, 10, axis=1)[:, :10]
+        neigh_topics = topics[content[top]]                 # [P, 10]
+        pur = float((neigh_topics == topics[probes][:, None]).mean())
+        # cosine margin on a subsample
+        sub = sims[:, :4000]
+        same = topics[content[:4000]][None, :] == topics[probes][:, None]
+        finite = np.isfinite(sub)
+        within = float(sub[same & finite].mean())
+        cross = float(sub[~same & finite].mean())
+        return pur, within - cross
+
+    emb = np.asarray(model.syn0, np.float32)
+    if np.isnan(emb).any():
+        return {"diverged": True,
+                "nan_rows": int(np.isnan(emb).any(axis=1).sum())}
+    pur, margin = purity(emb)
+    rnd = np.random.default_rng(1).normal(size=emb.shape).astype(np.float32)
+    pur0, margin0 = purity(rnd)
+    return {
+        "purity_at_10": round(pur, 4),
+        "purity_at_10_random_baseline": round(pur0, 4),
+        "cosine_margin": round(margin, 4),
+        "cosine_margin_random_baseline": round(margin0, 4),
+        "probes": int(probes.size),
+        "topics": T_TOPICS,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--words", type=int, default=17_000_000)
+    ap.add_argument("--out", default="/tmp/eval_corpus")
+    ap.add_argument("--corpus", default=None,
+                    help="existing token file (e.g. a real text8); skips generation "
+                         "AND the ground-truth quality metrics")
+    ap.add_argument("--dim", type=int, default=100)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--param-dtype", default="float32")
+    ap.add_argument("--batch", type=int, default=65536)
+    ap.add_argument("--pool", type=int, default=256,
+                    help="shared negative pool. Scale it with the batch: every pool "
+                         "row absorbs all pairs' negative gradients x negatives/pool, "
+                         "so batch*negatives/pool > ~2000 diverges at lr 0.025 "
+                         "(measured: B=64k/P=64 NaNs, B=64k/P=256 is the best "
+                         "quality of the sweep; see EVAL.md)")
+    args = ap.parse_args()
+
+    from glint_word2vec_tpu.data.corpus import TokenFileCorpus
+    from glint_word2vec_tpu.models.estimator import Word2Vec
+
+    os.makedirs(args.out, exist_ok=True)
+    if args.corpus:
+        corpus_path = args.corpus
+    else:
+        corpus_path = os.path.join(args.out, "corpus.txt")
+        if not os.path.exists(corpus_path):
+            generate_corpus(corpus_path, args.words, args.seed)
+        else:
+            log(f"reusing corpus at {corpus_path}")
+
+    sents = TokenFileCorpus(corpus_path)
+    est = Word2Vec(
+        vector_size=args.dim, min_count=5, window=5, negatives=5,
+        negative_pool=args.pool,
+        pairs_per_batch=args.batch, steps_per_dispatch=32, num_iterations=args.iters,
+        learning_rate=0.025, subsample_ratio=1e-4, seed=args.seed,
+        param_dtype=args.param_dtype,
+        compute_dtype=args.param_dtype)
+    heart = {"pps": []}
+    t0 = time.perf_counter()
+    model = est.fit(sents, encode_cache_dir=os.path.join(args.out, "encoded"))
+    train_s = time.perf_counter() - t0
+    # pairs/s from the training heartbeats would need trainer access; recompute from
+    # the corpus: pairs trained = sum over heartbeat... use wall-clock + vocab stats
+    log(f"trained: vocab {model.num_words:,}, d={args.dim}, {args.iters} iters "
+        f"in {train_s:.0f}s (incl. vocab+encode passes)")
+
+    np.save(os.path.join(args.out, "syn0.npy"),
+            np.asarray(model.syn0, np.float32))
+    with open(os.path.join(args.out, "vocab_words.txt"), "w") as f:
+        f.write("\n".join(model.vocab.words))
+    result = {
+        "metric": "topic_recovery_at_text8_scale",
+        "corpus_words": args.words,
+        "vocab_size": model.num_words,
+        "dim": args.dim,
+        "iterations": args.iters,
+        "train_seconds_total": round(train_s, 1),
+        "param_dtype": args.param_dtype,
+        "pairs_per_batch": args.batch,
+        "negative_pool": args.pool,
+    }
+    if not args.corpus:
+        result.update(evaluate(model))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
