@@ -115,6 +115,10 @@ class Word2VecConfig:
                                     # compute (the reference pipelines one minibatch deep
                                     # for the same reason, mllib:428-429). 0 = synchronous
                                     # (producer thread off; debugging aid)
+    profile_dir: str = ""           # non-empty: capture a jax.profiler trace of every
+                                    # fit() into this directory (view with TensorBoard
+                                    # or xprof; complements the host-wait/dispatch
+                                    # split the trainer always records)
     shard_input: bool = True        # multi-process runs: each process generates only its
                                     # own sentence shard (the repartition analog,
                                     # mllib:345) and per-round allgathers assemble the

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import logging
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -253,7 +253,8 @@ class Trainer:
         cfg = self.config
         if cfg.duplicate_scaling:
             return  # mean-update semantics bound both channels by construction
-        pool_load = cfg.pairs_per_batch * cfg.negatives / cfg.negative_pool
+        pool = cfg.negative_pool if cfg.negative_pool > 0 else 64  # pallas substitute
+        pool_load = cfg.pairs_per_batch * cfg.negatives / pool
         if pool_load > 2000:
             logger.warning(
                 "pairs_per_batch*negatives/negative_pool = %.0f > 2000: pool-row "
@@ -283,6 +284,12 @@ class Trainer:
         seed = np.uint32(cfg.seed & 0xFFFFFFFF)
         if cfg.use_pallas:
             from glint_word2vec_tpu.ops.pallas import sgns_kernel  # deferred import
+            if cfg.duplicate_scaling:
+                raise ValueError(
+                    "duplicate_scaling is not implemented for use_pallas=True — the "
+                    "fused kernel applies sum semantics only; use the XLA path or "
+                    "bound the row loads via negative_pool/subsample_ratio instead")
+            self._stability_warnings()
             if len(plan.mesh.devices.flat) > 1:
                 raise ValueError(
                     "use_pallas=True currently supports single-device plans only: the "
@@ -508,6 +515,7 @@ class Trainer:
                                batches_done=chunk["batches_done"]),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
         finally:
+            self._stop_profiler()
             closer = getattr(chunks, "close", None)
             if closer is not None:
                 closer()
@@ -526,6 +534,18 @@ class Trainer:
         self._last_log_time = time.perf_counter()
         self._last_log_step = self.global_step
         self._pairs_since_log = 0.0
+        self._profiling = False
+        if self.config.profile_dir:
+            import jax.profiler
+            jax.profiler.start_trace(self.config.profile_dir)
+            self._profiling = True
+            logger.info("jax.profiler trace -> %s", self.config.profile_dir)
+
+    def _stop_profiler(self) -> None:
+        if getattr(self, "_profiling", False):
+            import jax.profiler
+            jax.profiler.stop_trace()
+            self._profiling = False
 
     def _finish_round(
         self,
@@ -542,13 +562,11 @@ class Trainer:
         heartbeat cadence (the reference's every-10k-words line, mllib:404-413 —
         fetching device metrics forces a sync, so it runs on a chunked cadence to keep
         the async dispatch pipeline full), and periodic checkpointing."""
-        import dataclasses as _dc
-
         cfg = self.config
         self.global_step += real
         self._pairs_since_log += real_pairs
         self.pairs_trained += real_pairs
-        self.state = _dc.replace(state, global_step=self.global_step)
+        self.state = dc_replace(state, global_step=self.global_step)
 
         if self.global_step - self._last_log_step >= cfg.heartbeat_every_steps:
             now = time.perf_counter()
@@ -750,6 +768,7 @@ class Trainer:
                         shard_progress=[[int(a), int(b_)] for a, b_ in g["prog"]]),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
         finally:
+            self._stop_profiler()
             closer = getattr(chunks, "close", None)
             if closer is not None:
                 closer()

@@ -289,3 +289,22 @@ def test_exact_step_resume_matches_uninterrupted(tmp_path):
     resumed = Word2Vec.resume(path, sents)
     np.testing.assert_array_equal(
         np.asarray(resumed.syn0), np.asarray(baseline.syn0))
+
+
+def test_profile_dir_captures_trace(tmp_path):
+    """config.profile_dir wraps fit() in a jax.profiler trace (SURVEY §5: the
+    reference has no profiling at all; this plus the host-wait/dispatch split is
+    the observability story)."""
+    import os
+
+    from glint_word2vec_tpu import Word2Vec
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(30)]
+    sents = [[words[j] for j in rng.integers(0, 30, 8)] for _ in range(40)]
+    prof = str(tmp_path / "prof")
+    Word2Vec(vector_size=8, min_count=1, pairs_per_batch=64, num_iterations=1,
+             window=2, negatives=2, negative_pool=8, steps_per_dispatch=2,
+             seed=3, profile_dir=prof).fit(sents)
+    found = [os.path.join(r, f) for r, _, fs in os.walk(prof) for f in fs]
+    assert found, "profiler trace directory is empty"

@@ -1,0 +1,122 @@
+"""Mode-B deployment test: a SECOND process loads a checkpoint and serves model ops
+while the writer process is still alive — the analog of the reference's
+separate-PS-cluster mode (README.md:45-57; it spec:108-135,157-196), where query
+clients attach to PS state owned by another application.
+
+Covers: dense checkpoint serving, row-shards checkpoint serving onto the server's own
+mesh (streamed, no dense host copy), and the reload op picking up a newer checkpoint
+written by the trainer after the server started."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.pipeline import encode_sentences
+from glint_word2vec_tpu.data.vocab import build_vocab
+from glint_word2vec_tpu.train.trainer import Trainer
+
+SERVE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "serve_checkpoint.py")
+
+
+def _corpus(n=150, v=60, seed=2):
+    rng = np.random.default_rng(seed)
+    words = [f"w{i}" for i in range(v)]
+    return [[words[j] for j in rng.integers(0, v, 12)] for _ in range(n)]
+
+
+class _Server:
+    def __init__(self, path, mesh=None):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS",)}
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        repo = os.path.dirname(os.path.dirname(SERVE))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [sys.executable, SERVE, path] + (["--mesh", mesh] if mesh else [])
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env)
+        ready = json.loads(self.proc.stdout.readline())
+        assert ready.get("ready"), ready
+
+    def ask(self, **req):
+        self.proc.stdin.write(json.dumps(req) + "\n")
+        self.proc.stdin.flush()
+        return json.loads(self.proc.stdout.readline())
+
+    def close(self):
+        try:
+            self.ask(op="quit")
+        except Exception:
+            pass
+        self.proc.wait(timeout=30)
+
+
+@pytest.mark.slow
+def test_second_process_serves_checkpoint(tmp_path):
+    sents = _corpus()
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
+                         num_iterations=1, window=2, negatives=3, negative_pool=8,
+                         steps_per_dispatch=2, seed=9)
+    trainer = Trainer(cfg, vocab)
+    trainer.fit(encode_sentences(sents, vocab, cfg.max_sentence_length))
+    ck = str(tmp_path / "model")
+    trainer.save_checkpoint(ck)
+
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+    local = Word2VecModel.load(ck)
+    want = local.find_synonyms("w0", 5)
+
+    srv = _Server(ck)
+    try:
+        info = srv.ask(op="info")
+        assert info["num_words"] == vocab.size
+        got = srv.ask(op="synonyms", word="w0", num=5)["synonyms"]
+        assert [w for w, _ in got] == [w for w, _ in want]
+        np.testing.assert_allclose([s for _, s in got], [s for _, s in want],
+                                   rtol=1e-5)
+        vec = srv.ask(op="vector", word="w1")["vector"]
+        np.testing.assert_allclose(vec, local.transform("w1"), rtol=1e-6)
+
+        # the trainer keeps going and writes a NEWER checkpoint at the same path;
+        # the serving process picks it up with the reload op (mode-B lifecycle)
+        trainer.fit(encode_sentences(_corpus(seed=5), vocab,
+                                     cfg.max_sentence_length))
+        trainer.save_checkpoint(ck)
+        assert srv.ask(op="reload")["reloaded"]
+        got2 = srv.ask(op="synonyms", word="w0", num=5)["synonyms"]
+        want2 = Word2VecModel.load(ck).find_synonyms("w0", 5)
+        assert [w for w, _ in got2] == [w for w, _ in want2]
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_serving_row_shards_onto_own_mesh(tmp_path):
+    """Row-shards checkpoint served by a process that streams it onto its own 8-way
+    mesh — no dense [V, D] host copy in the serving process."""
+    sents = _corpus(seed=4)
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
+                         num_iterations=1, window=2, negatives=3, negative_pool=8,
+                         steps_per_dispatch=2, seed=11, sharded_checkpoint=True)
+    trainer = Trainer(cfg, vocab)
+    trainer.fit(encode_sentences(sents, vocab, cfg.max_sentence_length))
+    ck = str(tmp_path / "model")
+    trainer.save_checkpoint(ck)
+
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+    want = Word2VecModel.load(ck).find_synonyms("w0", 5)
+    srv = _Server(ck, mesh="1x8")
+    try:
+        got = srv.ask(op="synonyms", word="w0", num=5)["synonyms"]
+        assert [w for w, _ in got] == [w for w, _ in want]
+    finally:
+        srv.close()

@@ -196,7 +196,6 @@ def main():
         learning_rate=0.025, subsample_ratio=1e-4, seed=args.seed,
         param_dtype=args.param_dtype,
         compute_dtype=args.param_dtype)
-    heart = {"pps": []}
     t0 = time.perf_counter()
     model = est.fit(sents, encode_cache_dir=os.path.join(args.out, "encoded"))
     train_s = time.perf_counter() - t0

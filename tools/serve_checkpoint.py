@@ -1,0 +1,115 @@
+"""Mode-B deployment surface: serve model ops from a checkpoint in a separate process.
+
+The reference's mode B runs a standalone Glint PS cluster that training apps and query
+clients both attach to (README.md:45-57, it spec:108-135). The TPU-native analog
+(documented design call, models/compat.py): training owns the pod; QUERY serving reads
+checkpoints — any number of serving processes can load the same checkpoint directory
+(dense or row-shards; row-shards stream onto this process's mesh without a dense host
+copy) and answer transform/find_synonyms while training continues writing newer
+checkpoints alongside.
+
+Protocol: JSON-lines over stdin/stdout — one request object per line, one response
+object per line (the process-boundary analog of the reference's Akka query RPCs, with
+the same ops the PS served: pull / multiply+top-k, mllib:514,598):
+
+    {"op": "synonyms", "word": "berlin", "num": 10}
+    {"op": "synonyms_vec", "vector": [...], "num": 10}
+    {"op": "vector", "word": "berlin"}
+    {"op": "reload"}                      # pick up a newer checkpoint at the same path
+    {"op": "info"}
+
+Usage:
+    python tools/serve_checkpoint.py /path/to/checkpoint [--mesh DATAxMODEL]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    # honor JAX_PLATFORMS even on images whose sitecustomize pins the platform
+    # programmatically (env alone is not enough there — see tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    ap = argparse.ArgumentParser()
+    ap.add_argument("checkpoint")
+    ap.add_argument("--mesh", default=None,
+                    help="DATAxMODEL, e.g. 1x8: load row-shards straight onto this "
+                         "mesh (no dense host copy)")
+    args = ap.parse_args()
+
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    plan = None
+    if args.mesh:
+        d, m = (int(x) for x in args.mesh.lower().split("x"))
+        plan = make_mesh(d, m)
+
+    def load_with_retry(attempts=8, delay=0.25):
+        """The trainer's atomic swap has a sub-second window where the checkpoint
+        path is mid-rename / the old dir is being removed; a reload landing inside
+        it sees FileNotFoundError or a half-listed directory. Retry over the window
+        instead of bouncing the error to the client."""
+        import time
+        for i in range(attempts):
+            try:
+                return Word2VecModel.load(args.checkpoint, plan=plan)
+            except (FileNotFoundError, ValueError):
+                if i == attempts - 1:
+                    raise
+                time.sleep(delay)
+
+    model = load_with_retry()
+
+    def out(obj):
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    out({"ready": True, "num_words": model.num_words,
+         "vector_size": model.vector_size})
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            op = req["op"]
+            if op == "synonyms":
+                res = model.find_synonyms(req["word"], int(req.get("num", 10)))
+                out({"synonyms": [[w, s] for w, s in res]})
+            elif op == "synonyms_vec":
+                import numpy as np
+                vec = np.asarray(req["vector"], np.float32)
+                res = model.find_synonyms(vec, int(req.get("num", 10)))
+                out({"synonyms": [[w, s] for w, s in res]})
+            elif op == "vector":
+                out({"vector": model.transform(req["word"]).tolist()})
+            elif op == "reload":
+                old = model
+                model = load_with_retry()
+                old.stop()
+                out({"reloaded": True, "num_words": model.num_words})
+            elif op == "info":
+                out({"num_words": model.num_words,
+                     "vector_size": model.vector_size,
+                     "iteration": (model.train_state.iteration
+                                   if model.train_state else None),
+                     "finished": (model.train_state.finished
+                                  if model.train_state else None)})
+            elif op == "quit":
+                out({"bye": True})
+                break
+            else:
+                out({"error": f"unknown op {op!r}"})
+        except Exception as e:  # noqa: BLE001 — protocol errors go to the client
+            out({"error": f"{type(e).__name__}: {e}"})
+
+
+if __name__ == "__main__":
+    main()
