@@ -417,6 +417,69 @@ def cbow_step_core(
     return EmbeddingPair(new_syn0, new_syn1), metrics
 
 
+def cbow_step_shared_core(
+    params: EmbeddingPair,
+    centers: jax.Array,     # int32 [B]
+    contexts: jax.Array,    # int32 [B, C]
+    ctx_mask: jax.Array,    # float32 [B, C]
+    mask: jax.Array,        # float32 [B]
+    negatives: jax.Array,   # int32 [P] — pre-drawn shared pool
+    alpha: jax.Array,
+    num_negatives: int,
+    sigmoid_mode: str = "exact",
+    compute_dtype: jnp.dtype = jnp.float32,
+) -> Tuple[EmbeddingPair, StepMetrics]:
+    """CBOW with a batch-shared negative pool — the CBOW analog of
+    :func:`sgns_step_shared_core` (same estimator: each negative term reweighted by
+    ``num_negatives / pool`` so the expected gradient matches per-example sampling;
+    pool entries equal to an example's center are masked). All negative compute rides
+    the MXU: ``f_neg = hidden @ Zᵀ`` and ``dZ = g_negᵀ @ hidden``."""
+    syn0, syn1 = params
+    P = negatives.shape[0]
+    neg_valid = (negatives[None, :] != centers[:, None]).astype(jnp.float32) \
+        * mask[:, None]
+
+    e_ctx = syn0[contexts].astype(compute_dtype)                      # [B, C, D]
+    ctx_m = ctx_mask.astype(compute_dtype)[..., None]
+    ctx_n = jnp.maximum(ctx_mask.sum(axis=-1), 1.0).astype(compute_dtype)  # [B]
+    hidden = (e_ctx * ctx_m).sum(axis=1) / ctx_n[:, None]             # [B, D]
+
+    e_out = syn1[centers].astype(compute_dtype)                       # [B, D]
+    Z = syn1[negatives].astype(compute_dtype)                         # [P, D]
+    f_pos = jnp.sum(hidden * e_out, axis=-1).astype(jnp.float32)
+    f_neg = (hidden @ Z.T).astype(jnp.float32)                        # [B, P] — MXU
+
+    has_ctx = (ctx_mask.sum(axis=-1) > 0).astype(jnp.float32)
+    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask * has_ctx
+    g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid
+             * has_ctx[:, None] * (num_negatives / P))
+
+    gp = g_pos[:, None].astype(compute_dtype)
+    gn = g_neg.astype(compute_dtype)
+    d_hidden = gp * e_out + gn @ Z                                    # [B, D] — MXU
+    # mean convention: each context word gets d_hidden / |context|
+    d_ctx = (d_hidden / ctx_n[:, None])[:, None, :] * ctx_m
+    d_out = gp * hidden
+    d_Z = gn.T @ hidden                                               # [P, D] — MXU
+
+    dtype = syn0.dtype
+    D = syn0.shape[1]
+    new_syn0 = syn0.at[contexts.reshape(-1)].add(d_ctx.reshape(-1, D).astype(dtype))
+    new_syn1 = syn1.at[centers].add(d_out.astype(dtype))
+    new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
+
+    denom = jnp.maximum((mask * has_ctx).sum(), 1.0)
+    loss = (-_log_sigmoid(f_pos) * mask * has_ctx
+            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid * has_ctx[:, None], axis=-1)
+            * (num_negatives / P)).sum() / denom
+    metrics = StepMetrics(
+        loss=loss,
+        mean_f_pos=(f_pos * mask * has_ctx).sum() / denom,
+        pairs=(mask * has_ctx).sum(),
+    )
+    return EmbeddingPair(new_syn0, new_syn1), metrics
+
+
 def alpha_schedule(
     words_processed,
     total_words: float,

@@ -37,6 +37,7 @@ from glint_word2vec_tpu.ops.sgns import (
     StepMetrics,
     alpha_schedule,
     cbow_step_core,
+    cbow_step_shared_core,
     init_embeddings,
     sgns_step_core,
     sgns_step_shared_core,
@@ -282,6 +283,10 @@ class Trainer:
         # np.uint32 (not a Python int): any negative or 64-bit seed masked to 32 bits
         # lands in [2^31, 2^32), which jnp.asarray rejects under int32 canonicalization
         seed = np.uint32(cfg.seed & 0xFFFFFFFF)
+
+        def shared_pool_shape(K, B):  # negatives per chunk on the shared-pool paths
+            return (K, cfg.negative_pool)
+
         if cfg.use_pallas:
             from glint_word2vec_tpu.ops.pallas import sgns_kernel  # deferred import
             if cfg.duplicate_scaling:
@@ -318,12 +323,23 @@ class Trainer:
                     negatives, alpha, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
                     cfg.duplicate_scaling)
 
-            neg_shape = lambda K, B: (K, cfg.negative_pool)  # noqa: E731
+            neg_shape = shared_pool_shape
+        elif cfg.cbow and cfg.negative_pool > 0 and not cfg.duplicate_scaling:
+            self._stability_warnings()
+
+            def inner(params, batch, negatives, alpha):
+                return cbow_step_shared_core(
+                    params, batch["centers"], batch["contexts"], batch["ctx_mask"],
+                    batch["mask"], negatives, alpha, cfg.negatives,
+                    cfg.sigmoid_mode, compute_dtype)
+
+            neg_shape = shared_pool_shape
         elif cfg.cbow:
             if cfg.negative_pool > 0:
                 logger.warning(
-                    "negative_pool is not implemented for the CBOW path yet; "
-                    "using per-example negative sampling")
+                    "negative_pool is ignored for CBOW with duplicate_scaling=True "
+                    "(mean semantics are only implemented per-example); using "
+                    "per-example negative sampling")
 
             def inner(params, batch, negatives, alpha):
                 return cbow_step_core(

@@ -31,7 +31,7 @@ def _corpus(n=150, v=60, seed=2):
 
 
 class _Server:
-    def __init__(self, path, mesh=None):
+    def __init__(self, path, mesh=None, errfile=None):
         env = {k: v for k, v in os.environ.items()
                if k not in ("JAX_PLATFORMS",)}
         env["JAX_PLATFORMS"] = "cpu"
@@ -39,10 +39,22 @@ class _Server:
         repo = os.path.dirname(os.path.dirname(SERVE))
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         cmd = [sys.executable, SERVE, path] + (["--mesh", mesh] if mesh else [])
+        # stderr goes to a FILE, not a pipe: nobody drains a pipe (64KB of XLA
+        # warnings would deadlock the child), and on a startup crash the file
+        # holds the real traceback for the assertion message below
+        self._errpath = errfile or (path + ".server-stderr")
+        self._errf = open(self._errpath, "w")
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True, env=env)
-        ready = json.loads(self.proc.stdout.readline())
+            stderr=self._errf, text=True, env=env)
+        line = self.proc.stdout.readline()
+        try:
+            ready = json.loads(line)
+        except json.JSONDecodeError:
+            self._errf.flush()
+            raise AssertionError(
+                f"server died at startup; stderr tail:\n"
+                f"{open(self._errpath).read()[-3000:]}") from None
         assert ready.get("ready"), ready
 
     def ask(self, **req):
@@ -56,6 +68,7 @@ class _Server:
         except Exception:
             pass
         self.proc.wait(timeout=30)
+        self._errf.close()
 
 
 @pytest.mark.slow

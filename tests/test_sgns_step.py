@@ -323,3 +323,53 @@ def test_shared_pool_duplicate_scaling_mean_semantics():
     d_scaled = np.abs(many0[2] - np.asarray(syn0)[2]).sum()
     d_sum = np.abs(sum0[2] - np.asarray(syn0)[2]).sum()
     assert d_sum > 5 * d_scaled
+
+
+def test_cbow_shared_pool_learns_and_masks():
+    """CBOW shared-pool path (the CBOW TPU fast tier): learns a predictive toy task,
+    zero-masked batches are no-ops, and pool==center collisions contribute nothing."""
+    import jax
+
+    from glint_word2vec_tpu.ops.sgns import (
+        EmbeddingPair, cbow_step_shared_core, init_embeddings)
+
+    V, D, B, C, P = 20, 16, 128, 4, 8
+    rng = np.random.default_rng(0)
+    params = init_embeddings(V, D, jax.random.key(1))
+    params = EmbeddingPair(params.syn0, params.syn0[::-1] * 0.5)
+    # predictable structure: center = (first context + 1) % 10
+    contexts = jnp.asarray(rng.integers(0, 10, (B, C)), jnp.int32)
+    centers = (contexts[:, 0] + 1) % 10
+    ctx_mask = jnp.ones((B, C), jnp.float32)
+    mask = jnp.ones(B, jnp.float32)
+
+    def step(p, i):
+        pool = jnp.asarray(rng.integers(10, V, P), jnp.int32)  # disjoint negatives
+        return cbow_step_shared_core(
+            p, centers, contexts, ctx_mask, mask, pool, jnp.float32(0.05), 3)
+
+    losses = []
+    for i in range(40):
+        params, m = jax.jit(step, static_argnums=1)(params, i)
+        losses.append(float(m.loss))
+    assert np.all(np.isfinite(np.asarray(params.syn0)))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+    # fully masked batch: params unchanged, zero loss
+    zp, zm = cbow_step_shared_core(
+        params, centers, contexts, ctx_mask, jnp.zeros(B, jnp.float32),
+        jnp.asarray(rng.integers(10, V, P), jnp.int32), jnp.float32(0.05), 3)
+    np.testing.assert_array_equal(np.asarray(zp.syn0), np.asarray(params.syn0))
+    assert float(zm.loss) == 0.0
+
+    # pool made entirely of the centers themselves -> negative term fully masked:
+    # identical update to a pool of valid negatives with zero gradient coefficient
+    all_self = jnp.full((P,), int(centers[0]), jnp.int32)
+    sp, sm = cbow_step_shared_core(
+        params, centers[:1], contexts[:1], ctx_mask[:1], mask[:1],
+        all_self, jnp.float32(0.05), 3)
+    f = float(sm.mean_f_pos)
+    assert np.isfinite(f)
+    # loss reduces to the positive term only
+    expected = float(np.log1p(np.exp(-f)))
+    np.testing.assert_allclose(float(sm.loss), expected, rtol=1e-5)

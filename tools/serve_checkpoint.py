@@ -60,7 +60,10 @@ def main():
         for i in range(attempts):
             try:
                 return Word2VecModel.load(args.checkpoint, plan=plan)
-            except (FileNotFoundError, ValueError):
+            # only the transient swap-window failures: a missing path or
+            # half-written JSON. Permanent problems (bad --mesh for the shard
+            # layout, corrupt arrays) surface immediately instead of retrying.
+            except (FileNotFoundError, json.JSONDecodeError):
                 if i == attempts - 1:
                     raise
                 time.sleep(delay)
