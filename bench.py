@@ -284,6 +284,14 @@ def main() -> None:
     log(f"device: {dev} ({dev.platform})")
     counts = zipf_counts(V)
 
+    # e2e runs FIRST: the step benches leave multi-GB allocator/page-cache state
+    # behind that measurably slows the host producer thread (median e2e dropped
+    # ~2x when run last)
+    try:
+        e2e_pps = bench_e2e()
+    except Exception as e:
+        log(f"e2e bench failed: {type(e).__name__}: {e}")
+        e2e_pps = None
     rows = {}
     rows["f32_32k"] = bench_step(counts, b=32768)
     rows["f32_64k"] = bench_step(counts, b=65536)
@@ -297,11 +305,6 @@ def main() -> None:
         bench_step(counts, b=8192, use_pallas=True)
     except Exception as e:
         log(f"pallas step failed: {type(e).__name__}: {e}")
-    try:
-        e2e_pps = bench_e2e()
-    except Exception as e:
-        log(f"e2e bench failed: {type(e).__name__}: {e}")
-        e2e_pps = None
 
     try:
         cpu_pps = bench_cpu_torch(counts)

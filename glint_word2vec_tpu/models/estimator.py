@@ -101,12 +101,30 @@ class Word2Vec:
         from glint_word2vec_tpu.data.corpus import (
             EncodedCorpus, encode_corpus, vocab_fingerprint)
         from glint_word2vec_tpu.ops.sgns import EmbeddingPair
-        from glint_word2vec_tpu.train.checkpoint import load_model
+        from glint_word2vec_tpu.train.checkpoint import (
+            load_model, load_model_header, load_params_into_plan)
 
-        data = load_model(checkpoint_path)
-        cfg: Word2VecConfig = data["config"]
-        state = data["train_state"]
-        vocab = Vocabulary.from_words_and_counts(data["words"], data["counts"])
+        header = load_model_header(checkpoint_path)
+        cfg: Word2VecConfig = header["config"]
+        state = header["train_state"]
+        vocab = Vocabulary.from_words_and_counts(header["words"], header["counts"])
+        streamed = None
+        if plan is not None and header["layout"] == "row-shards":
+            # stream the shards straight onto the target mesh — resume at the 10M-row
+            # north star must not materialize [V, D] on one host (same path as
+            # Word2VecModel.load(plan=...))
+            from glint_word2vec_tpu.parallel.mesh import (
+                pad_dim_to_lanes, pad_vocab_for_sharding)
+            pv = pad_vocab_for_sharding(vocab.size, plan.num_model)
+            pd = pad_dim_to_lanes(cfg.vector_size, cfg.pad_vector_to_lanes)
+            syn0, syn1 = load_params_into_plan(
+                checkpoint_path, plan, pv, pd, dtype=np.dtype(cfg.param_dtype))
+            if syn1 is None:
+                raise ValueError("checkpoint has no syn1; cannot resume training")
+            streamed = EmbeddingPair(syn0, syn1)
+            data = None
+        else:
+            data = load_model(checkpoint_path, header=header)
         if isinstance(sentences, EncodedCorpus):
             encoded = sentences
         elif encode_cache_dir is not None:
@@ -127,10 +145,14 @@ class Word2Vec:
             if iter(sentences) is sentences:
                 sentences = list(sentences)
             encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
-        if data["syn1"] is None:
-            raise ValueError("checkpoint has no syn1; cannot resume training")
-        import jax.numpy as jnp
-        params = EmbeddingPair(jnp.asarray(data["syn0"]), jnp.asarray(data["syn1"]))
+        if streamed is not None:
+            params = streamed
+        else:
+            if data["syn1"] is None:
+                raise ValueError("checkpoint has no syn1; cannot resume training")
+            import jax.numpy as jnp
+            params = EmbeddingPair(
+                jnp.asarray(data["syn0"]), jnp.asarray(data["syn1"]))
         trainer = Trainer(cfg, vocab, plan=plan, params=params, train_state=state)
         if not state.finished:
             # pass checkpoint_every_steps explicitly to keep periodic checkpointing

@@ -128,6 +128,13 @@ def shard_batch(batch, plan: MeshPlan):
     return jax.tree.map(lambda a: jax.device_put(a, plan.batch), batch)
 
 
+def pad_dim_to_lanes(vector_size: int, enabled: bool = True) -> int:
+    """Physical embedding minor dim: padded up to the TPU lane width (128) when
+    enabled. Trainer and every streamed-load path MUST agree on this value — a
+    mismatch silently falls back to host-side re-padding of the full matrices."""
+    return -(-vector_size // 128) * 128 if enabled else vector_size
+
+
 def pad_vocab_for_sharding(vocab_size: int, num_model: int, multiple: int = 8) -> int:
     """Smallest padded row count divisible by num_model (and a lane-friendly multiple).
 

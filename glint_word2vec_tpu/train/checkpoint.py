@@ -313,7 +313,8 @@ class ShardedMatrixReader:
         return self.read(0, self.rows)
 
 
-def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int):
+def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
+                          dtype=np.float32):
     """Stream a row-shards checkpoint straight onto a target mesh (which may differ
     from the one that wrote it — the reference's load-onto-new-PS-topology path,
     mllib:696-725): each device's row block is read from the mmap'd shard files by a
@@ -338,7 +339,7 @@ def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int):
             rows = idx[0]
             start = rows.start or 0
             stop = rows.stop if rows.stop is not None else padded_vocab
-            block = np.zeros((stop - start, padded_dim), dtype=np.float32)
+            block = np.zeros((stop - start, padded_dim), dtype=dtype)
             lo, hi = start, min(stop, V)  # rows beyond the real vocab stay zero
             if lo < hi:
                 src = reader.read(lo, hi)
@@ -369,6 +370,11 @@ def load_model_header(path: str) -> Dict[str, Any]:
     with open(os.path.join(path, "words"), "r", encoding="utf-8") as f:
         words = [line.rstrip("\n") for line in f if line.rstrip("\n")]
     counts = np.load(os.path.join(path, "counts.npy"))
+    declared = meta.get("vocab_size")
+    if declared is not None and declared != len(words):
+        raise ValueError(
+            f"words sidecar has {len(words)} entries but metadata declares "
+            f"vocab_size {declared} — corrupt or hand-edited checkpoint")
     return {
         "words": words,
         "counts": counts,

@@ -43,7 +43,8 @@ from glint_word2vec_tpu.ops.sgns import (
     sgns_step_shared_core,
 )
 from glint_word2vec_tpu.parallel.distributed import put_global
-from glint_word2vec_tpu.parallel.mesh import MeshPlan, make_mesh, pad_vocab_for_sharding
+from glint_word2vec_tpu.parallel.mesh import (
+    MeshPlan, make_mesh, pad_dim_to_lanes, pad_vocab_for_sharding)
 from glint_word2vec_tpu.train.checkpoint import TrainState, save_model
 
 logger = logging.getLogger("glint_word2vec_tpu")
@@ -164,9 +165,8 @@ class Trainer:
         # gathers/scatters measurably slower than at 384. Padded columns are zero-init and
         # receive zero gradient (all products with the zero columns vanish), so they stay
         # zero and are sliced off on export.
-        self.padded_dim = (
-            -(-config.vector_size // 128) * 128
-            if config.pad_vector_to_lanes else config.vector_size)
+        self.padded_dim = pad_dim_to_lanes(
+            config.vector_size, config.pad_vector_to_lanes)
         self.table = build_alias_table(vocab.counts, config.sample_power)
         # replicated device copies, passed into the jitted chunk as ARGUMENTS every
         # dispatch — closure-captured constants take a catastrophically slow gather
@@ -184,6 +184,7 @@ class Trainer:
                 dtype=jnp.dtype(config.param_dtype))
         if (isinstance(params.syn0, jax.Array)
                 and params.syn0.shape == (self.padded_vocab, self.padded_dim)
+                and params.syn0.dtype == jnp.dtype(config.param_dtype)
                 and params.syn0.sharding.is_equivalent_to(plan.embedding, 2)):
             # already padded and placed (e.g. streamed in by load_params_into_plan)
             self.params = params

@@ -86,10 +86,9 @@ if mode == "resume":
     header = load_model_header(ck)
     st = header["train_state"]
     assert st.shard_progress is not None and len(st.shard_progress) == 2
-    from glint_word2vec_tpu.parallel.mesh import pad_vocab_for_sharding
+    from glint_word2vec_tpu.parallel.mesh import pad_dim_to_lanes, pad_vocab_for_sharding
     pv = pad_vocab_for_sharding(vocab.size, plan.num_model)
-    pd = (-(-cfg.vector_size // 128) * 128 if cfg.pad_vector_to_lanes
-          else cfg.vector_size)
+    pd = pad_dim_to_lanes(cfg.vector_size, cfg.pad_vector_to_lanes)
     syn0, syn1 = load_params_into_plan(ck, plan, pv, pd)
     from glint_word2vec_tpu.ops.sgns import EmbeddingPair
     t2 = Trainer(cfg, vocab, plan=plan, params=EmbeddingPair(syn0, syn1),

@@ -158,3 +158,30 @@ def test_model_load_dense_checkpoint_with_plan(tmp_path):
     assert model._full0.sharding.is_equivalent_to(plan.embedding, 2)
     np.testing.assert_allclose(
         model.pull([0, 1]), np.asarray(trainer.unpadded_params().syn0)[:2], rtol=1e-6)
+
+
+def test_estimator_resume_streams_sharded_checkpoint(trained, monkeypatch, tmp_path):
+    """Word2Vec.resume(path, plan=...) on a row-shards checkpoint streams params onto
+    the mesh — the dense load path (full [V, D] on one host) must never run."""
+    trainer, vocab, cfg, path = trained
+    from glint_word2vec_tpu.models.estimator import Word2Vec
+    from glint_word2vec_tpu.train import checkpoint as ckpt
+
+    # a mid-run checkpoint: mark unfinished so resume actually trains
+    st = ckpt.TrainState(iteration=1, words_processed=0, finished=False,
+                         global_step=trainer.global_step, batches_done=0)
+    from glint_word2vec_tpu.train.checkpoint import save_model_sharded
+    ck = str(tmp_path / "midrun")
+    save_model_sharded(ck, vocab.words, vocab.counts,
+                       trainer.params.syn0, trainer.params.syn1, cfg, st,
+                       vocab_size=vocab.size, vector_size=cfg.vector_size)
+
+    def boom(_path, header=None):
+        raise AssertionError("dense load_model must not run on the streamed path")
+
+    monkeypatch.setattr(ckpt, "load_model", boom)
+    plan2 = make_mesh(2, 4)
+    sents = _small_corpus(60)
+    model = Word2Vec.resume(ck, sents, plan=plan2)
+    assert model.num_words == vocab.size
+    assert np.isfinite(model.pull([0, 1])).all()
