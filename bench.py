@@ -20,9 +20,12 @@ report fantasy numbers. Every number here is a two-point SLOPE over donated,
 data-dependent chunk chains ending in a device→host fetch — constant overheads cancel,
 elision is impossible.
 
-Reported rows (stderr):
-    step xla f32        — the default-precision step at B=32k (round-2 continuity) + 64k
-    step xla bf16       — bf16-stored embeddings: rows are 768 B instead of 1536 B, and
+Reported rows (stderr; e2e runs FIRST — the step benches leave allocator state
+behind that throttles the host producer):
+    e2e trainer         — Word2Vec-style end-to-end incl. the host pipeline (median
+                          of 3 trials; single trials scatter 2x through the tunnel)
+    step xla f32/f32    — the default-precision step at B=32k (round-2 continuity) + 64k
+    step xla bf16/bf16  — bf16-stored embeddings: rows are 768 B instead of 1536 B, and
                           the step is row-byte-bound, so this is the single biggest
                           lever (measured +30-40%). Both toy-corpus semantic gates pass
                           at bf16 (tests/test_integration_toy.py gates re-run at
@@ -41,7 +44,6 @@ Reported rows (stderr):
                           row-at-a-time design cannot beat XLA's vectorized
                           gather/scatter (~60-90 ns/row). Demoted, not deleted: the
                           analysis is recorded in ops/pallas/sgns_kernel.py.
-    e2e trainer         — Word2Vec-style end-to-end incl. the host pipeline
     cpu-torch           — identical step math on the host CPU (the measured baseline)
 
 MFU ceiling analysis (why the BASELINE ≥50% north star does not apply to SGNS):

@@ -29,7 +29,7 @@ Behavioral notes vs. the reference (intentional divergences, each covered by a u
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import Iterable, Iterator, List, Sequence, Tuple
 
 import numpy as np
 

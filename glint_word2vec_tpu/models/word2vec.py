@@ -27,7 +27,7 @@ import numpy as np
 
 from glint_word2vec_tpu.config import Word2VecConfig
 from glint_word2vec_tpu.data.vocab import Vocabulary
-from glint_word2vec_tpu.parallel.mesh import MeshPlan, make_mesh, pad_vocab_for_sharding
+from glint_word2vec_tpu.parallel.mesh import MeshPlan, pad_vocab_for_sharding
 from glint_word2vec_tpu.train import checkpoint as ckpt
 
 logger = logging.getLogger("glint_word2vec_tpu")

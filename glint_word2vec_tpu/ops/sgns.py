@@ -28,7 +28,7 @@ unlike the reference's accepted Hogwild races (README.md:17-19).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,7 @@ the input pipe rides DCN while the training collectives ride ICI.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
