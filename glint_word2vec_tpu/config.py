@@ -54,6 +54,12 @@ class Word2VecConfig:
     num_model_shards: int = 1       # ≈ numParameterServers (mllib:78,204-212): how many ways
                                     # the embedding rows are sharded over the mesh 'model' axis
     num_data_shards: int = 1        # data-parallel degree over the mesh 'data' axis
+    embedding_partition: str = "rows"  # "rows" (north-star: V/N rows per device) or
+                                       # "cols" (CIKM'16: D/N columns per device,
+                                       # partial dots + psum — the reference PS
+                                       # layout, G2/SURVEY §7.4). Identical math,
+                                       # different collective profile; row-shards
+                                       # checkpoints require "rows"
     mesh_shape: Optional[Tuple[int, int]] = None  # explicit (data, model) mesh; default derives
                                                   # from num_data_shards × num_model_shards
 
@@ -128,6 +134,10 @@ class Word2VecConfig:
                                     # CBOW multi-process stays on the replicated feed.
 
     def __post_init__(self) -> None:
+        if self.embedding_partition not in ("rows", "cols"):
+            raise ValueError(
+                f"embedding_partition must be 'rows' or 'cols', "
+                f"got {self.embedding_partition!r}")
         if self.vector_size <= 0:
             raise ValueError(f"vector_size must be positive but got {self.vector_size}")
         if self.learning_rate <= 0:

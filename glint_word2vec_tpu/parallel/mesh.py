@@ -52,6 +52,24 @@ class MeshPlan:
         return NamedSharding(self.mesh, P(MODEL_AXIS, None))
 
     @property
+    def embedding_cols(self) -> NamedSharding:
+        """Column-sharded [V, D] embeddings — the CIKM'16 scheme the reference's PS
+        uses (G2: each server computes partial dot products over its slice of every
+        vector; SURVEY §7.4 asks for both layouts). Under GSPMD the per-shard partial
+        dots become a psum over the model axis instead of row gathers/scatters
+        crossing devices. Same math, different collective profile:
+
+        - rows: minibatch row fetch/update is an all-to-all over the model axis
+          (each device owns V/N full rows); collective bytes scale with the number
+          of OFF-SHARD rows touched.
+        - cols: every device computes f_pos/f_neg partials on its D/N slice of every
+          touched row, then one psum of [B(, P)] scalars; row access is device-local.
+
+        Which wins depends on batch size vs vector width and the interconnect —
+        measure on real multi-chip hardware via config.embedding_partition."""
+        return NamedSharding(self.mesh, P(None, MODEL_AXIS))
+
+    @property
     def batch(self) -> NamedSharding:
         """[B, ...] batches split over the data axis, replicated over model."""
         return NamedSharding(self.mesh, P(DATA_AXIS))

@@ -160,6 +160,12 @@ class Trainer:
                 shape = (1, 1)
             plan = make_mesh(*shape)
         self.plan = plan
+        if config.embedding_partition == "cols" and (
+                config.sharded_checkpoint or jax.process_count() > 1):
+            raise ValueError(
+                "embedding_partition='cols' is incompatible with row-shards "
+                "checkpoints (each process writes full rows); use 'rows' for "
+                "multi-process / sharded_checkpoint runs")
         self.padded_vocab = pad_vocab_for_sharding(vocab.size, plan.num_model)
         # Pad the minor dim to the TPU lane width: D=300 rows are misaligned and row
         # gathers/scatters measurably slower than at 384. Padded columns are zero-init and
@@ -167,6 +173,13 @@ class Trainer:
         # zero and are sliced off on export.
         self.padded_dim = pad_dim_to_lanes(
             config.vector_size, config.pad_vector_to_lanes)
+        self._emb_sharding = (plan.embedding_cols
+                              if config.embedding_partition == "cols"
+                              else plan.embedding)
+        if config.embedding_partition == "cols" and self.padded_dim % plan.num_model:
+            raise ValueError(
+                f"embedding_partition='cols' needs the padded vector dim "
+                f"{self.padded_dim} divisible by num_model={plan.num_model}")
         self.table = build_alias_table(vocab.counts, config.sample_power)
         # replicated device copies, passed into the jitted chunk as ARGUMENTS every
         # dispatch — closure-captured constants take a catastrophically slow gather
@@ -185,13 +198,13 @@ class Trainer:
         if (isinstance(params.syn0, jax.Array)
                 and params.syn0.shape == (self.padded_vocab, self.padded_dim)
                 and params.syn0.dtype == jnp.dtype(config.param_dtype)
-                and params.syn0.sharding.is_equivalent_to(plan.embedding, 2)):
+                and params.syn0.sharding.is_equivalent_to(self._emb_sharding, 2)):
             # already padded and placed (e.g. streamed in by load_params_into_plan)
             self.params = params
         else:
             params = self._pad_params(params)
             placed = put_global(
-                plan.embedding,
+                self._emb_sharding,
                 # every process computes the same deterministic init (same key), so
                 # the callback assembly is consistent across hosts
                 {"syn0": np.asarray(params.syn0), "syn1": np.asarray(params.syn1)})
@@ -360,6 +373,7 @@ class Trainer:
 
         is_cbow = cfg.cbow
         S = self._feed_segments
+        emb_sharding = self._emb_sharding
 
         def chunk(params, arrays, meta, base_step, prob, alias):
             # scan over steps_per_dispatch stacked batches in one device dispatch:
@@ -401,7 +415,7 @@ class Trainer:
                     batch = {"centers": prs[0], "contexts": prs[1], "mask": mask}
                 new_p, metrics = inner(p, batch, negs, alpha)
                 new_p = jax.lax.with_sharding_constraint(
-                    new_p, EmbeddingPair(plan.embedding, plan.embedding))
+                    new_p, EmbeddingPair(emb_sharding, emb_sharding))
                 return new_p, metrics
 
             return jax.lax.scan(body, params, (arrays, alphas, reals, negatives))

@@ -102,3 +102,62 @@ def test_graft_entry_dryrun_multichip():
 
     ge.dryrun_multichip(8)
     ge.dryrun_multichip(2)
+
+
+def test_row_and_column_sharding_train_identically():
+    """GSPMD layout-independence: the same training run under row-sharded
+    (north-star) and column-sharded (CIKM'16 / reference-PS, G2) embeddings must
+    produce numerically identical params — the layouts differ only in which
+    collectives XLA inserts (SURVEY §7.4's open question; per-chip timing needs
+    real multi-chip hardware, correctness does not)."""
+    import numpy as np
+
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(40)]
+    sents = [[words[j] for j in rng.integers(0, 40, 10)] for _ in range(120)]
+    vocab = build_vocab(sents, min_count=1)
+
+    def run(partition):
+        cfg = Word2VecConfig(vector_size=128, min_count=1, pairs_per_batch=256,
+                             num_iterations=1, window=2, negatives=3,
+                             negative_pool=8, steps_per_dispatch=2, seed=5,
+                             embedding_partition=partition)
+        plan = make_mesh(1, 8)
+        tr = Trainer(cfg, vocab, plan=plan)
+        tr.fit(encode_sentences(sents, vocab, cfg.max_sentence_length))
+        return tr
+
+    t_rows = run("rows")
+    t_cols = run("cols")
+    assert t_rows.params.syn0.sharding.is_equivalent_to(
+        t_rows.plan.embedding, 2)
+    assert t_cols.params.syn0.sharding.is_equivalent_to(
+        t_cols.plan.embedding_cols, 2)
+    np.testing.assert_allclose(
+        np.asarray(t_rows.params.syn0), np.asarray(t_cols.params.syn0),
+        rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(t_rows.params.syn1), np.asarray(t_cols.params.syn1),
+        rtol=1e-5, atol=1e-7)
+
+
+def test_column_sharding_rejects_sharded_checkpoint():
+    import pytest
+
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    sents = [["a", "b", "c"]] * 10
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=128, min_count=1,
+                         embedding_partition="cols", sharded_checkpoint=True)
+    with pytest.raises(ValueError, match="cols"):
+        Trainer(cfg, vocab, plan=make_mesh(1, 8))
