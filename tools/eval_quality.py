@@ -167,12 +167,13 @@ def main():
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--param-dtype", default="float32")
     ap.add_argument("--batch", type=int, default=65536)
-    ap.add_argument("--pool", type=int, default=256,
+    ap.add_argument("--pool", type=int, default=512,
                     help="shared negative pool. Scale it with the batch: every pool "
                          "row absorbs all pairs' negative gradients x negatives/pool, "
-                         "so batch*negatives/pool > ~2000 diverges at lr 0.025 "
-                         "(measured: B=64k/P=64 NaNs, B=64k/P=256 is the best "
-                         "quality of the sweep; see EVAL.md)")
+                         "and the pool + duplicate-context channels compound on "
+                         "frequent rows over long runs (measured: B=64k/P=64 NaNs at "
+                         "17M words; B=64k/P=256 is stable at 17M but NaNs at 60M; "
+                         "P>=512 holds at 60M; see EVAL.md)")
     args = ap.parse_args()
 
     from glint_word2vec_tpu.data.corpus import TokenFileCorpus
