@@ -289,6 +289,16 @@ class Trainer:
                 "set subsample_ratio (~1e-4, recommended) or "
                 "duplicate_scaling=True, or shrink pairs_per_batch (EVAL.md)",
                 cfg.pairs_per_batch, dup_load)
+        elif pool_load > 1000 and dup_load > 150:
+            # the channels COMPOUND on frequent syn1 rows over long runs: B=64k/P=256
+            # (pool 1280, dups ~260 — neither alone past its threshold) was stable on
+            # a 17M-word corpus but NaN'd at 60M; either channel halved holds (EVAL.md)
+            logger.warning(
+                "pool load %.0f and top-word duplicate load %.0f are each below "
+                "their individual divergence thresholds but compound on frequent "
+                "rows over long runs (measured NaN at 60M words, EVAL.md) — for "
+                "long runs grow negative_pool (load <= ~600) or shrink "
+                "pairs_per_batch", pool_load, dup_load)
 
     def _build_step(self) -> Callable:
         cfg = self.config

@@ -308,3 +308,41 @@ def test_profile_dir_captures_trace(tmp_path):
              seed=3, profile_dir=prof).fit(sents)
     found = [os.path.join(r, f) for r, _, fs in os.walk(prof) for f in fs]
     assert found, "profiler trace directory is empty"
+
+
+def test_stability_warnings_fire(caplog):
+    """The trainer warns on the three measured divergence regimes (EVAL.md): pool
+    overload, duplicate overload, and the compounding band that NaN'd at 60M words
+    while passing both individual thresholds."""
+    import logging
+
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.vocab import Vocabulary
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    # Zipfy counts: top word ~0.4% of the (unsubsampled) stream
+    counts = np.maximum(2_000_000 / (np.arange(5000) + 10.0) ** 1.05, 5).astype(int)
+    vocab = Vocabulary.from_words_and_counts(
+        [f"w{i}" for i in range(5000)], counts)
+
+    def warns(**kw):
+        cfg = Word2VecConfig(vector_size=16, min_count=1, **kw)
+        with caplog.at_level(logging.WARNING, logger="glint_word2vec_tpu"):
+            caplog.clear()
+            Trainer(cfg, vocab)
+        return [r.message for r in caplog.records]
+
+    # pool overload: load 5120
+    assert any("pool" in m for m in warns(
+        pairs_per_batch=65536, negatives=5, negative_pool=64,
+        subsample_ratio=1e-4))
+    # duplicate overload: no subsampling, top word >300 dups per 64k batch
+    assert any("duplicates" in m for m in warns(
+        pairs_per_batch=65536, negatives=5, negative_pool=1024))
+    # compounding band: both below individual thresholds, warned jointly
+    msgs = warns(pairs_per_batch=65536, negatives=5, negative_pool=256,
+                 subsample_ratio=1e-4)
+    assert any("compound" in m for m in msgs), msgs
+    # a safe config stays quiet
+    assert not warns(pairs_per_batch=16384, negatives=5, negative_pool=64,
+                     subsample_ratio=1e-4)
