@@ -93,6 +93,13 @@ class Word2VecConfig:
                                       # sliced back to vector_size
     param_dtype: str = "float32"    # embedding storage dtype
     compute_dtype: str = "float32"  # dot-product dtype ("bfloat16" rides the MXU)
+    logits_dtype: str = "float32"   # dtype of the [B, pool] negative-logit chain on
+                                    # the shared-pool paths (f_neg → sigmoid → g_neg).
+                                    # f32 matches the reference's client-side math
+                                    # (mllib:421-425); "bfloat16" halves what is, at
+                                    # pool >= 512, several full passes over a [B, pool]
+                                    # array (PERF.md §4) — coefficients are O(lr·n/pool)
+                                    # and tolerate the ~0.4% relative noise
     use_pallas: bool = False        # fused Pallas SGNS kernel for the hot step
     sharded_checkpoint: bool = False  # row-shards save (each process writes its own
                                       # rows, no host gather — G9 analog); forced on
@@ -179,6 +186,10 @@ class Word2VecConfig:
         if self.num_data_shards <= 0:
             raise ValueError(
                 f"num_data_shards must be positive but got {self.num_data_shards}")
+        if self.logits_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"logits_dtype must be 'float32' or 'bfloat16' "
+                f"but got {self.logits_dtype!r}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
         return dataclasses.replace(self, **kwargs)

@@ -250,6 +250,7 @@ def sgns_step_shared_core(
     sigmoid_mode: str = "exact",
     compute_dtype: jnp.dtype = jnp.float32,
     duplicate_scaling: bool = False,
+    logits_dtype: jnp.dtype = jnp.float32,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """:func:`sgns_step_shared` with the pool supplied by the caller (see
     :func:`sgns_step_core` for why sampling lives outside the jitted scan).
@@ -262,7 +263,15 @@ def sgns_step_shared_core(
     subsampling, at the cost of slower differentiation of frequent rows (and, for pool
     rows, a much smaller effective negative step, since their contribution count is
     ~B). Frequency subsampling (subsample_ratio ≈ 1e-4) is usually the better fix —
-    see EVAL.md."""
+    see EVAL.md.
+
+    ``logits_dtype`` is the dtype of the [B, P] negative-logit chain (f_neg → sigmoid
+    → g_neg). The default float32 matches the reference's client-side float math
+    (mllib:421-425). At pool ≥ 512 the f32 chain is several full passes over a
+    [B, P] array (~268 MB at B=64k/P=1024) and becomes a measurable slice of the
+    step (PERF.md §4); ``bfloat16`` keeps it in half precision — gradient
+    coefficients are O(α·n/P) and tolerate ~0.4% relative noise. Loss/metric
+    reductions still accumulate in f32."""
     syn0, syn1 = params
     P = negatives.shape[0]
     V = syn0.shape[0]
@@ -271,25 +280,29 @@ def sgns_step_shared_core(
     Z = syn1[negatives].astype(compute_dtype)           # [P, D]
 
     f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)
-    f_neg = (e_in @ Z.T).astype(jnp.float32)            # [B, P] — MXU
-    neg_valid = (negatives[None, :] != contexts[:, None]).astype(jnp.float32) \
-        * mask[:, None]
+    f_neg = (e_in @ Z.T).astype(logits_dtype)           # [B, P] — MXU
+    neg_valid = (negatives[None, :] != contexts[:, None]).astype(logits_dtype) \
+        * mask[:, None].astype(logits_dtype)
 
     g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask
-    g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid
-             * (num_negatives / P))
+    g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode))
+             * jnp.asarray(alpha, logits_dtype) * neg_valid
+             * jnp.asarray(num_negatives / P, logits_dtype))
 
     if duplicate_scaling:
         cnt0 = jnp.zeros(V, jnp.float32).at[centers].add(mask)
         cnt1 = jnp.zeros(V, jnp.float32).at[contexts].add(mask)
         in_scale = 1.0 / jnp.maximum(cnt0[centers], 1.0)
         g_pos_in = g_pos * in_scale
-        g_neg_in = g_neg * in_scale[:, None]
+        # keep the [B, P] chain in logits_dtype (bf16 x f32 would promote and
+        # materialize the f32 array this option exists to avoid); 1/count is safe
+        g_neg_in = g_neg * in_scale[:, None].astype(logits_dtype)
         g_pos_out = g_pos / jnp.maximum(cnt1[contexts], 1.0)
         # pool row p: mean over its contributing pairs, then divided by how many
         # pool slots hold the same word (their scatter-adds would otherwise sum)
         pool_mult = jnp.zeros(V, jnp.float32).at[negatives].add(1.0)[negatives]
-        z_scale = 1.0 / (jnp.maximum(neg_valid.sum(axis=0), 1.0) * pool_mult)
+        z_scale = 1.0 / (jnp.maximum(neg_valid.sum(axis=0, dtype=jnp.float32), 1.0)
+                         * pool_mult)
     else:
         g_pos_in, g_neg_in, g_pos_out = g_pos, g_neg, g_pos
         z_scale = None
@@ -310,7 +323,8 @@ def sgns_step_shared_core(
 
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = (-_log_sigmoid(f_pos) * mask
-            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1)
+            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1,
+                      dtype=jnp.float32)
             * (num_negatives / P)).sum() / denom
     metrics = StepMetrics(
         loss=loss,
@@ -428,16 +442,18 @@ def cbow_step_shared_core(
     num_negatives: int,
     sigmoid_mode: str = "exact",
     compute_dtype: jnp.dtype = jnp.float32,
+    logits_dtype: jnp.dtype = jnp.float32,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """CBOW with a batch-shared negative pool — the CBOW analog of
     :func:`sgns_step_shared_core` (same estimator: each negative term reweighted by
     ``num_negatives / pool`` so the expected gradient matches per-example sampling;
     pool entries equal to an example's center are masked). All negative compute rides
-    the MXU: ``f_neg = hidden @ Zᵀ`` and ``dZ = g_negᵀ @ hidden``."""
+    the MXU: ``f_neg = hidden @ Zᵀ`` and ``dZ = g_negᵀ @ hidden``. ``logits_dtype``
+    as in :func:`sgns_step_shared_core` (the [B, P] chain)."""
     syn0, syn1 = params
     P = negatives.shape[0]
-    neg_valid = (negatives[None, :] != centers[:, None]).astype(jnp.float32) \
-        * mask[:, None]
+    neg_valid = (negatives[None, :] != centers[:, None]).astype(logits_dtype) \
+        * mask[:, None].astype(logits_dtype)
 
     e_ctx = syn0[contexts].astype(compute_dtype)                      # [B, C, D]
     ctx_m = ctx_mask.astype(compute_dtype)[..., None]
@@ -447,12 +463,14 @@ def cbow_step_shared_core(
     e_out = syn1[centers].astype(compute_dtype)                       # [B, D]
     Z = syn1[negatives].astype(compute_dtype)                         # [P, D]
     f_pos = jnp.sum(hidden * e_out, axis=-1).astype(jnp.float32)
-    f_neg = (hidden @ Z.T).astype(jnp.float32)                        # [B, P] — MXU
+    f_neg = (hidden @ Z.T).astype(logits_dtype)                       # [B, P] — MXU
 
     has_ctx = (ctx_mask.sum(axis=-1) > 0).astype(jnp.float32)
     g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask * has_ctx
-    g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid
-             * has_ctx[:, None] * (num_negatives / P))
+    g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode))
+             * jnp.asarray(alpha, logits_dtype) * neg_valid
+             * has_ctx[:, None].astype(logits_dtype)
+             * jnp.asarray(num_negatives / P, logits_dtype))
 
     gp = g_pos[:, None].astype(compute_dtype)
     gn = g_neg.astype(compute_dtype)
@@ -470,7 +488,9 @@ def cbow_step_shared_core(
 
     denom = jnp.maximum((mask * has_ctx).sum(), 1.0)
     loss = (-_log_sigmoid(f_pos) * mask * has_ctx
-            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid * has_ctx[:, None], axis=-1)
+            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid
+                      * has_ctx[:, None].astype(logits_dtype), axis=-1,
+                      dtype=jnp.float32)
             * (num_negatives / P)).sum() / denom
     metrics = StepMetrics(
         loss=loss,

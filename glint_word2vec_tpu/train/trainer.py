@@ -303,6 +303,14 @@ class Trainer:
     def _build_step(self) -> Callable:
         cfg = self.config
         compute_dtype = jnp.dtype(cfg.compute_dtype)
+        logits_dtype = jnp.dtype(cfg.logits_dtype)
+        if logits_dtype != jnp.float32 and not (
+                cfg.negative_pool > 0 and not cfg.use_pallas
+                and not (cfg.cbow and cfg.duplicate_scaling)):
+            logger.warning(
+                "logits_dtype=%s only applies to the shared-pool XLA paths "
+                "(negative_pool > 0, no pallas, no CBOW+duplicate_scaling); this "
+                "configuration keeps the float32 logit chain", cfg.logits_dtype)
         plan = self.plan
         # np.uint32 (not a Python int): any negative or 64-bit seed masked to 32 bits
         # lands in [2^31, 2^32), which jnp.asarray rejects under int32 canonicalization
@@ -345,7 +353,7 @@ class Trainer:
                 return sgns_step_shared_core(
                     params, batch["centers"], batch["contexts"], batch["mask"],
                     negatives, alpha, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
-                    cfg.duplicate_scaling)
+                    cfg.duplicate_scaling, logits_dtype)
 
             neg_shape = shared_pool_shape
         elif cfg.cbow and cfg.negative_pool > 0 and not cfg.duplicate_scaling:
@@ -355,7 +363,7 @@ class Trainer:
                 return cbow_step_shared_core(
                     params, batch["centers"], batch["contexts"], batch["ctx_mask"],
                     batch["mask"], negatives, alpha, cfg.negatives,
-                    cfg.sigmoid_mode, compute_dtype)
+                    cfg.sigmoid_mode, compute_dtype, logits_dtype)
 
             neg_shape = shared_pool_shape
         elif cfg.cbow:

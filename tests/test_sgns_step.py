@@ -289,6 +289,40 @@ def test_shared_negative_pool_collision_masked():
     np.testing.assert_allclose(float(m.loss), expected_loss, rtol=1e-5)
 
 
+def test_shared_pool_bf16_logits_tracks_f32(setup):
+    """logits_dtype="bfloat16" (PERF.md §4: halves the [B, P] chain's bandwidth) must
+    produce the same update direction with only half-precision rounding noise: the
+    per-row deltas stay within bf16 relative tolerance of the f32-logit step, and the
+    CBOW shared path mirrors it."""
+    from glint_word2vec_tpu.ops.sgns import (
+        cbow_step_shared_core, sgns_step_shared_core)
+    params, table, centers, contexts, mask = setup
+    negs = jnp.asarray(np.random.default_rng(7).integers(0, V, 16), jnp.int32)
+    ref, m_ref = sgns_step_shared_core(
+        params, centers, contexts, mask, negs, jnp.float32(0.05), N)
+    lo, m_lo = sgns_step_shared_core(
+        params, centers, contexts, mask, negs, jnp.float32(0.05), N,
+        logits_dtype=jnp.bfloat16)
+    d_ref = np.asarray(ref.syn0) - np.asarray(params.syn0)
+    d_lo = np.asarray(lo.syn0) - np.asarray(params.syn0)
+    # bf16 has ~3 significant digits; deltas are tiny so compare against scale
+    np.testing.assert_allclose(d_lo, d_ref, atol=2e-2 * np.abs(d_ref).max())
+    np.testing.assert_allclose(float(m_lo.loss), float(m_ref.loss), rtol=2e-2)
+
+    C = 4
+    ctx = jnp.asarray(np.random.default_rng(8).integers(0, V, (B, C)), jnp.int32)
+    cmask = jnp.ones((B, C), jnp.float32)
+    ref_c, mc_ref = cbow_step_shared_core(
+        params, centers, ctx, cmask, mask, negs, jnp.float32(0.05), N)
+    lo_c, mc_lo = cbow_step_shared_core(
+        params, centers, ctx, cmask, mask, negs, jnp.float32(0.05), N,
+        logits_dtype=jnp.bfloat16)
+    d_ref = np.asarray(ref_c.syn1) - np.asarray(params.syn1)
+    d_lo = np.asarray(lo_c.syn1) - np.asarray(params.syn1)
+    np.testing.assert_allclose(d_lo, d_ref, atol=2e-2 * np.abs(d_ref).max())
+    np.testing.assert_allclose(float(mc_lo.loss), float(mc_ref.loss), rtol=2e-2)
+
+
 def test_shared_pool_duplicate_scaling_mean_semantics():
     """With duplicate_scaling=True on the shared-pool path, R identical pairs move
     each row exactly as far as ONE pair does (mean of identical updates), bounding the

@@ -1,0 +1,321 @@
+"""Full-step A/B: current 3-scatter shared-pool SGNS step vs merged-scatter variants.
+
+HLO analysis (tools/scatter_model.py + compiled-HLO dump) shows each scatter-add pays
+a fixed cost — index sort + a [B,D] update permute + a serial sorted-scatter emitter
+(~27 ns/row) — and the production step pays it three times (syn0[centers],
+syn1[contexts], syn1[pool]). Variants measured here, all mathematically identical to
+sgns_step_shared_core (scatter-add is order-independent up to FP associativity):
+
+    current     — sgns_step_shared_core as shipped (3 scatters)
+    merged-syn1 — contexts+pool in one scatter (2 scatters)
+    merged-all  — one [2V,D] array, centers/contexts/pool in ONE scatter
+    merged-all + dense head H — rows < H updated via one-hot matmul (MXU) and a
+                  dense slab add; only tail rows scattered. Exact (one-hot of a
+                  head row is zero for tail ids), no compaction needed for A/B —
+                  scatter still processes B rows but the cost model says rows are
+                  what matters, so this row only shows matmul overhead vs scatter
+                  savings potential with compaction.
+
+Run: python tools/step_ab.py [--dtype f32|bf16] [--b 65536] [--pool 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V, D, NEG, K = 200_000, 384, 5, 16
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dtype", default="f32", choices=["f32", "bf16"])
+    ap.add_argument("--b", type=int, default=65536)
+    ap.add_argument("--pool", type=int, default=256)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+    B, P = args.b, args.pool
+
+    import jax
+    import jax.numpy as jnp
+    from microbench import time_chunked
+
+    from glint_word2vec_tpu.ops.sampler import build_alias_table, sample_negatives_hash
+    from glint_word2vec_tpu.ops.sgns import (
+        EmbeddingPair, _log_sigmoid, _sigmoid, init_embeddings,
+        sgns_step_shared_core)
+
+    dt = jnp.float32 if args.dtype == "f32" else jnp.bfloat16
+    print(f"device: {jax.devices()[0]}  dtype={args.dtype} B={B} pool={P}",
+          file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    counts = np.maximum(1e9 / (np.arange(V) + 10.0) ** 1.07, 5.0)
+    p = counts / counts.sum()
+    table = build_alias_table(counts)
+    prob, alias = table.prob, table.alias
+    syn0_0 = init_embeddings(V, D, jax.random.key(0)).syn0.astype(dt)
+    syn1_0 = jnp.asarray(rng.normal(0, 0.05, (V, D)), dt)
+
+    batches = []
+    for i in range(12):
+        r = np.random.default_rng(1000 + i)
+        batches.append({
+            "centers": jnp.asarray(r.choice(V, size=(K, B), p=p), jnp.int32),
+            "contexts": jnp.asarray(r.choice(V, size=(K, B), p=p), jnp.int32),
+            "mask": jnp.ones((K, B), jnp.float32),
+        })
+
+    def core_merged(syn, centers, contexts, mask, negatives, alpha, dense_head=0):
+        """One-scatter variant on merged [2V, D] (rows V..2V-1 are syn1)."""
+        cdt = jnp.float32
+        e_in = syn[centers].astype(cdt)
+        e_pos = syn[V + contexts].astype(cdt)
+        Z = syn[V + negatives].astype(cdt)
+        f_pos = jnp.sum(e_in * e_pos, axis=-1)
+        f_neg = e_in @ Z.T
+        neg_valid = (negatives[None, :] != contexts[:, None]).astype(cdt) \
+            * mask[:, None]
+        g_pos = (1.0 - _sigmoid(f_pos, "exact")) * alpha * mask
+        g_neg = (0.0 - _sigmoid(f_neg, "exact")) * alpha * neg_valid * (NEG / P)
+        d_in = g_pos[:, None] * e_pos + g_neg @ Z
+        d_pos = g_pos[:, None] * e_in
+        d_Z = g_neg.T @ e_in
+        idx = jnp.concatenate([centers, V + contexts, V + negatives])
+        upd = jnp.concatenate([d_in, d_pos, d_Z]).astype(syn.dtype)
+        if dense_head:
+            H = dense_head
+            # head rows (idx % V < H) ride the MXU: one-hot matmul -> dense add
+            local = jnp.where(idx >= V, idx - V, idx)
+            half = (idx >= V).astype(jnp.int32)
+            is_head = local < H
+            oh = ((local[:, None] == jnp.arange(H)[None, :]) &
+                  (half[:, None] == 0)).astype(upd.dtype)
+            oh1 = ((local[:, None] == jnp.arange(H)[None, :]) &
+                   (half[:, None] == 1)).astype(upd.dtype)
+            head0 = oh.T @ upd
+            head1 = oh1.T @ upd
+            syn = syn.at[:H].add(head0)
+            syn = syn.at[V:V + H].add(head1)
+            idx = jnp.where(is_head, 2 * V, idx)  # dropped
+            syn = syn.at[idx].add(upd, mode="drop")
+        else:
+            syn = syn.at[idx].add(upd)
+        loss = (-_log_sigmoid(f_pos) * mask
+                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1)
+                * (NEG / P)).sum() / jnp.maximum(mask.sum(), 1.0)
+        return syn, loss
+
+    def core_merged_syn1(params, centers, contexts, mask, negatives, alpha):
+        """contexts+pool in one scatter; syn0/syn1 stay separate (2 scatters)."""
+        syn0, syn1 = params
+        cdt = jnp.float32
+        e_in = syn0[centers].astype(cdt)
+        e_pos = syn1[contexts].astype(cdt)
+        Z = syn1[negatives].astype(cdt)
+        f_pos = jnp.sum(e_in * e_pos, axis=-1)
+        f_neg = e_in @ Z.T
+        neg_valid = (negatives[None, :] != contexts[:, None]).astype(cdt) \
+            * mask[:, None]
+        g_pos = (1.0 - _sigmoid(f_pos, "exact")) * alpha * mask
+        g_neg = (0.0 - _sigmoid(f_neg, "exact")) * alpha * neg_valid * (NEG / P)
+        d_in = g_pos[:, None] * e_pos + g_neg @ Z
+        d_pos = g_pos[:, None] * e_in
+        d_Z = g_neg.T @ e_in
+        new_syn0 = syn0.at[centers].add(d_in.astype(syn0.dtype))
+        idx1 = jnp.concatenate([contexts, negatives])
+        upd1 = jnp.concatenate([d_pos, d_Z]).astype(syn1.dtype)
+        new_syn1 = syn1.at[idx1].add(upd1)
+        loss = (-_log_sigmoid(f_pos) * mask
+                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1)
+                * (NEG / P)).sum() / jnp.maximum(mask.sum(), 1.0)
+        return EmbeddingPair(new_syn0, new_syn1), loss
+
+    def make_runner(kind, dense_head=0):
+        def chunk(state, batch, base_step, prob, alias):
+            negs = sample_negatives_hash(prob, alias, 1234, base_step, (K, P))
+
+            def body(s, inp):
+                b, ng = inp
+                if kind == "current":
+                    new_p, m = sgns_step_shared_core(
+                        s, b["centers"], b["contexts"], b["mask"], ng,
+                        jnp.float32(0.025), NEG, "exact", jnp.float32)
+                    return new_p, m.loss
+                if kind == "merged_syn1":
+                    return core_merged_syn1(
+                        s, b["centers"], b["contexts"], b["mask"], ng,
+                        jnp.float32(0.025))
+                return core_merged(
+                    s, b["centers"], b["contexts"], b["mask"], ng,
+                    jnp.float32(0.025), dense_head)
+            return jax.lax.scan(body, state, (batch, negs))
+
+        f = jax.jit(chunk, donate_argnums=(0,))
+
+        if kind == "merged":
+            def mk():
+                return jnp.concatenate([syn0_0, syn1_0])
+        else:
+            def mk():
+                return EmbeddingPair(syn0_0 + 0, syn1_0 + 0)
+
+        def run():
+            return time_chunked(
+                f, mk, lambda i: (batches[i % 12], np.int32(100 + i), prob, alias),
+                n_lo=2, n_hi=8, fetch=lambda c, out: out[-1])
+        return run
+
+    # ---- center-grouped variant: the reference's wOutput shape (mllib:419) ----
+    # skip-gram emits ~2*window pairs per center; grouping contexts per center
+    # cuts syn0 gather+scatter rows and the pool matmul by the group width.
+    W = 10                      # 2*window slots
+    FILL = 0.655                # mean window fill under the reference's shrink rule
+    Bc = max(1, int(B * 1.0 / (W * FILL)))  # groups per batch ~ same real pairs
+
+    gbatches = []
+    for i in range(12):
+        r = np.random.default_rng(2000 + i)
+        centers = np.sort(r.choice(V, size=(K, Bc), p=p), axis=-1)  # host-sorted
+        ctx = r.choice(V, size=(K, Bc, W), p=p)
+        n_ctx = r.integers(1, W + 1, size=(K, Bc))
+        cmask = (np.arange(W)[None, None, :] < n_ctx[..., None])
+        gbatches.append({
+            "centers": jnp.asarray(centers, jnp.int32),
+            "ctx": jnp.asarray(ctx, jnp.int32),
+            "cmask": jnp.asarray(cmask, jnp.float32),
+        })
+    real_pairs = float(np.mean([np.asarray(g["cmask"]).sum(axis=(1, 2)).mean()
+                                for g in gbatches]))
+
+    def core_grouped(params, centers, ctx, cmask, negatives, alpha):
+        syn0, syn1 = params
+        cdt = jnp.float32
+        e_in = syn0[centers].astype(cdt)                 # [Bc, D]
+        e_pos = syn1[ctx].astype(cdt)                    # [Bc, W, D]
+        Z = syn1[negatives].astype(cdt)                  # [P, D]
+        f_pos = jnp.einsum("bd,bwd->bw", e_in, e_pos)
+        f_neg = e_in @ Z.T                               # [Bc, P] — per center!
+        neg_valid = (negatives[None, :] != centers[:, None]).astype(cdt)
+        n_ctx = cmask.sum(axis=-1)                       # [Bc]
+        g_pos = (1.0 - _sigmoid(f_pos, "exact")) * alpha * cmask
+        # per-pair negative term depends only on the center -> weight by n_ctx
+        g_neg = ((0.0 - _sigmoid(f_neg, "exact")) * alpha * neg_valid
+                 * (NEG / P)) * n_ctx[:, None]
+        d_in = jnp.einsum("bw,bwd->bd", g_pos, e_pos) + g_neg @ Z
+        d_pos = g_pos[..., None] * e_in[:, None, :]      # [Bc, W, D]
+        d_Z = g_neg.T @ e_in                             # [P, D]
+        new_syn0 = syn0.at[centers].add(d_in.astype(syn0.dtype),
+                                        indices_are_sorted=True)
+        new_syn1 = syn1.at[ctx.reshape(-1)].add(
+            d_pos.reshape(-1, D).astype(syn1.dtype))
+        new_syn1 = new_syn1.at[negatives].add(d_Z.astype(syn1.dtype))
+        loss = (f_pos * cmask).sum() / jnp.maximum(cmask.sum(), 1.0)
+        return EmbeddingPair(new_syn0, new_syn1), loss
+
+    def make_grouped_runner():
+        def chunk(state, batch, base_step, prob, alias):
+            negs = sample_negatives_hash(prob, alias, 1234, base_step, (K, P))
+
+            def body(s, inp):
+                b, ng = inp
+                return core_grouped(s, b["centers"], b["ctx"], b["cmask"], ng,
+                                    jnp.float32(0.025))
+            return jax.lax.scan(body, state, (batch, negs))
+
+        f = jax.jit(chunk, donate_argnums=(0,))
+
+        def run():
+            return time_chunked(
+                f, lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
+                lambda i: (gbatches[i % 12], np.int32(100 + i), prob, alias),
+                n_lo=2, n_hi=8, fetch=lambda c, out: out[-1])
+        return run
+
+    # ---- host-sorted batch + indices_are_sorted on the syn0 scatter ----------
+    sbatches = []
+    for i in range(12):
+        b = batches[i]
+        c = np.asarray(b["centers"])
+        x = np.asarray(b["contexts"])
+        order = np.argsort(c, axis=-1)
+        sbatches.append({
+            "centers": jnp.asarray(np.take_along_axis(c, order, -1), jnp.int32),
+            "contexts": jnp.asarray(np.take_along_axis(x, order, -1), jnp.int32),
+            "mask": b["mask"],
+        })
+
+    def core_sorted(params, centers, contexts, mask, negatives, alpha):
+        syn0, syn1 = params
+        cdt = jnp.float32
+        e_in = syn0[centers].astype(cdt)
+        e_pos = syn1[contexts].astype(cdt)
+        Z = syn1[negatives].astype(cdt)
+        f_pos = jnp.sum(e_in * e_pos, axis=-1)
+        f_neg = e_in @ Z.T
+        neg_valid = (negatives[None, :] != contexts[:, None]).astype(cdt) \
+            * mask[:, None]
+        g_pos = (1.0 - _sigmoid(f_pos, "exact")) * alpha * mask
+        g_neg = (0.0 - _sigmoid(f_neg, "exact")) * alpha * neg_valid * (NEG / P)
+        d_in = g_pos[:, None] * e_pos + g_neg @ Z
+        d_pos = g_pos[:, None] * e_in
+        d_Z = g_neg.T @ e_in
+        new_syn0 = syn0.at[centers].add(d_in.astype(syn0.dtype),
+                                        indices_are_sorted=True)
+        new_syn1 = syn1.at[contexts].add(d_pos.astype(syn1.dtype))
+        new_syn1 = new_syn1.at[negatives].add(d_Z.astype(syn1.dtype))
+        loss = (-_log_sigmoid(f_pos) * mask
+                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1)
+                * (NEG / P)).sum() / jnp.maximum(mask.sum(), 1.0)
+        return EmbeddingPair(new_syn0, new_syn1), loss
+
+    def make_sorted_runner():
+        def chunk(state, batch, base_step, prob, alias):
+            negs = sample_negatives_hash(prob, alias, 1234, base_step, (K, P))
+
+            def body(s, inp):
+                b, ng = inp
+                return core_sorted(s, b["centers"], b["contexts"], b["mask"], ng,
+                                   jnp.float32(0.025))
+            return jax.lax.scan(body, state, (batch, negs))
+
+        f = jax.jit(chunk, donate_argnums=(0,))
+
+        def run():
+            return time_chunked(
+                f, lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
+                lambda i: (sbatches[i % 12], np.int32(100 + i), prob, alias),
+                n_lo=2, n_hi=8, fetch=lambda c, out: out[-1])
+        return run
+
+    runners = {
+        "current (3 scatters)": make_runner("current"),
+        "sorted-centers + flag": make_sorted_runner(),
+        "merged-syn1 (2 scatters)": make_runner("merged_syn1"),
+        "grouped-centers": make_grouped_runner(),
+    }
+    times = {k: [] for k in runners}
+    for r in range(args.repeats):
+        for name, run in runners.items():
+            spc = run()
+            times[name].append(spc / K * 1e3)
+    print(f"\nSGNS step A/B (B={B}, pool={P}, {args.dtype}, median of "
+          f"{args.repeats} interleaved repeats):", file=sys.stderr)
+    for name, ts in times.items():
+        med = float(np.median(ts))
+        pairs = real_pairs if name == "grouped-centers" else B
+        print(f"  {name:28s} median {med:7.3f} ms/step  "
+              f"[{min(ts):7.3f} .. {max(ts):7.3f}]  "
+              f"{pairs / (med / 1e3):13,.0f} pairs/s", file=sys.stderr)
+    print(f"  (grouped: Bc={Bc} groups x W={W} slots, "
+          f"{real_pairs:,.0f} real pairs/step)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
