@@ -139,6 +139,24 @@ class Word2VecConfig:
                                     # hosts. False = every process regenerates the full
                                     # stream (zero-coordination fallback). Skip-gram only;
                                     # CBOW multi-process stays on the replicated feed.
+    device_pairgen: bool = False    # generate training pairs ON DEVICE (ops/pairgen.py):
+                                    # the host subsamples and ships kept-token blocks
+                                    # (~1 byte/pair on the wire vs 4 for packed pairs)
+                                    # and the jitted step derives window draws from the
+                                    # same position-keyed hash lattice as the host
+                                    # pipeline. The stream is deterministic per seed but
+                                    # NOT bit-identical to the host feed's (windows are
+                                    # keyed by kept-token ordinals and blocks cut at the
+                                    # token budget — statistically identical; contract
+                                    # + tests in ops/pairgen.py). Use when the
+                                    # host→device feed link is the bottleneck (thin
+                                    # PCIe/DCN/tunnel links). Skip-gram single-process
+                                    # only (CBOW and the multi-process allgather feed
+                                    # stay on host generation)
+    tokens_per_step: int = 0        # device_pairgen: raw token slots per step; 0 sizes
+                                    # automatically from pairs_per_batch, window, and the
+                                    # subsample keep ratio (targeting ~93% pair-slot fill;
+                                    # overflow pairs are dropped and counted)
 
     def __post_init__(self) -> None:
         if self.embedding_partition not in ("rows", "cols"):
@@ -190,6 +208,9 @@ class Word2VecConfig:
             raise ValueError(
                 f"logits_dtype must be 'float32' or 'bfloat16' "
                 f"but got {self.logits_dtype!r}")
+        if self.tokens_per_step < 0:
+            raise ValueError(
+                f"tokens_per_step must be nonnegative but got {self.tokens_per_step}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
         return dataclasses.replace(self, **kwargs)

@@ -320,20 +320,7 @@ def epoch_batches(
     token_base = 0   # raw tokens consumed in prior blocks (position-key base)
     words_seen = 0
 
-    def block_iter():
-        block: List[np.ndarray] = []
-        nwords = 0
-        for si in order:
-            s = sentences[si]
-            block.append(s)
-            nwords += s.shape[0]
-            if nwords >= block_words:
-                yield block
-                block, nwords = [], 0
-        if block:
-            yield block
-
-    for block in block_iter():
+    for block in iter_sentence_slabs(sentences, order, block_words):
         tokens = np.concatenate(block) if len(block) > 1 else block[0]
         lengths = np.fromiter((s.shape[0] for s in block), np.int64, len(block))
         gen = block_pairs_native if use_native else _block_pairs
@@ -357,6 +344,28 @@ def epoch_batches(
     # trailing subsampled words with no emitted pairs still count toward the clock for
     # the *next* iteration's prev_words baseline — callers use iteration boundaries, so
     # nothing further to emit here
+
+
+def iter_sentence_slabs(
+    sentences: Sequence[np.ndarray],
+    order: np.ndarray,
+    block_words: int = 1_000_000,
+) -> Iterator[List[np.ndarray]]:
+    """Whole-sentence slabs of ~``block_words`` raw tokens in the given order — the
+    vectorization granule shared by the host pair pipeline (:func:`epoch_batches`)
+    and the device-feed packer (train/trainer._fit_device_feed), so their stream
+    contracts stay aligned on one slab rule."""
+    slab: List[np.ndarray] = []
+    nwords = 0
+    for si in order:
+        s = sentences[si]
+        slab.append(s)
+        nwords += s.shape[0]
+        if nwords >= block_words:
+            yield slab
+            slab, nwords = [], 0
+    if slab:
+        yield slab
 
 
 def count_train_words(sentences: Sequence[np.ndarray]) -> int:

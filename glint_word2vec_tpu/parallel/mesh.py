@@ -94,6 +94,14 @@ class MeshPlan:
         return NamedSharding(self.mesh, P(None, DATA_AXIS, None))
 
     @property
+    def tokens_stacked(self) -> NamedSharding:
+        """[K, S, T] raw-token chunk for the on-device pair generator
+        (ops/pairgen.py): scan axis replicated, segment axis split over data (each
+        data shard expands its own token blocks into pairs locally — no cross-shard
+        traffic in the generator), token axis local."""
+        return NamedSharding(self.mesh, P(None, DATA_AXIS, None))
+
+    @property
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 

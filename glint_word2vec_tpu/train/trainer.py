@@ -233,6 +233,51 @@ class Trainer:
                     f"shard_input=True needs pairs_per_batch divisible by the "
                     f"process count ({config.pairs_per_batch} % {n} != 0)")
             self._feed_segments = n
+        # On-device pair generation (ops/pairgen.py): host ships raw token blocks,
+        # the jitted step subsamples + windows them itself — same hash lattice, so
+        # the pair stream is bit-identical to the host pipeline's.
+        if config.device_pairgen:
+            if config.cbow:
+                raise ValueError("device_pairgen is skip-gram only (CBOW batches "
+                                 "are grouped windows the device generator does "
+                                 "not produce)")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "device_pairgen does not support multi-process runs yet — "
+                    "use the host feed (shard_input allgather protocol) there")
+            if config.use_pallas:
+                raise ValueError("device_pairgen is not supported with use_pallas")
+            S = self.plan.num_data
+            if config.pairs_per_batch % S:
+                raise ValueError(
+                    f"device_pairgen needs pairs_per_batch divisible by the data-"
+                    f"parallel degree ({config.pairs_per_batch} % {S} != 0)")
+            if config.window == 1:
+                raise ValueError(
+                    "device_pairgen with window=1 emits no pairs at all under the "
+                    "reference's legacy asymmetric window (b = nextInt(1) = 0 "
+                    "always, and the right bound is exclusive) — use window >= 2")
+            from glint_word2vec_tpu.data.pipeline import keep_probabilities
+            keep = keep_probabilities(
+                vocab.counts, vocab.train_words_count,
+                config.subsample_ratio).astype(np.float32)
+            self._keep_host = keep
+            kp = np.zeros(self.padded_vocab, np.float32)
+            kp[:vocab.size] = keep
+            self._keep_prob_dev = put_global(plan.replicated, {"k": kp})["k"]
+            self._tokens_per_step = (config.tokens_per_step
+                                     or self._auto_tokens_per_step())
+            # ops/pairgen._cumsum_i32 is exact only while prefix sums stay below
+            # 2^24 (f32 mantissa); the largest sum is T * (2*window - 1) pair counts
+            if self._tokens_per_step * (2 * config.window - 1) >= 1 << 24:
+                raise ValueError(
+                    f"tokens_per_step={self._tokens_per_step} with window="
+                    f"{config.window} overflows the device generator's exact-f32 "
+                    f"prefix-sum bound (T * (2*window - 1) must stay below 2^24); "
+                    "lower tokens_per_step or split the batch")
+            self._chunk_shardings = {"tokens": plan.tokens_stacked,
+                                     "starts": plan.tokens_stacked,
+                                     "obase": plan.tokens_stacked}
         # resume continues the (seed, counter) PRNG lattice where the checkpoint left
         # off — restarting at 0 would redraw the run's opening negative-sample stream
         self.global_step = self.state.global_step
@@ -241,6 +286,21 @@ class Trainer:
         self._step_fn = self._build_step()
 
     # -- setup -------------------------------------------------------------------------
+
+    def _auto_tokens_per_step(self) -> int:
+        """Token slots per step for the device pair generator: targets ~93% pair-slot
+        fill from the analytic per-kept-token pair rate E[window span] (boundary
+        clipping at sentence edges is ignored, which *overestimates* the rate, so the
+        realized fill lands safely below target instead of overflowing). A step's
+        actual pair count concentrates tightly (std ≈ √T window-draw noise, <1% of B),
+        so overflow drops stay rare; the trainer counts and reports them."""
+        cfg = self.config
+        b = np.arange(cfg.window, dtype=np.float64)  # nextInt(window) draws
+        rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()  # legacy window
+        # the packer subsamples host-side, so shipped tokens are KEPT tokens
+        rate = max(rate_per_kept, 1e-3)
+        T = int(np.ceil(0.93 * cfg.pairs_per_batch / self.plan.num_data / rate))
+        return max(T, 64)
 
     def _pad_params(self, params: EmbeddingPair) -> EmbeddingPair:
         def pad(a):
@@ -393,6 +453,47 @@ class Trainer:
         S = self._feed_segments
         emb_sharding = self._emb_sharding
 
+        if cfg.device_pairgen:
+            from glint_word2vec_tpu.ops.pairgen import device_block_pairs
+            W = cfg.window
+            Sd = self.plan.num_data
+            Bl = cfg.pairs_per_batch // Sd
+            T = self._tokens_per_step
+
+            gen = jax.vmap(
+                lambda tk, st, nv, lo, hi, kp, sb, wb: device_block_pairs(
+                    tk, st, nv, lo, hi, kp, sb, wb,
+                    window=W, num_pairs=Bl, presubsampled=True),
+                in_axes=(0, 0, 0, 0, 0, None, 0, 0))
+
+            def device_chunk(params, arrays, meta, base_step, prob, alias,
+                             keep_prob, sub_bases, win_bases):
+                # meta rows: [0] per-step alphas; [1:1+Sd] per-segment valid-token
+                # counts. Pair counts are unknown to the host here — the device
+                # derives them; exact totals ride back in the scanned metrics.
+                alphas, nvalid = meta[0], meta[1:].T          # [K], [K, Sd]
+                K = alphas.shape[0]
+                negatives = sample_negatives_hash(
+                    prob, alias, seed, base_step, neg_shape(K, Sd * Bl))
+
+                def body(p, inp):
+                    xs, alpha, nv, negs = inp
+                    ob = jax.lax.bitcast_convert_type(xs["obase"], jnp.uint32)
+                    dp = gen(xs["tokens"].astype(jnp.int32), xs["starts"],
+                             nv.astype(jnp.int32), ob[:, 0], ob[:, 1],
+                             keep_prob, sub_bases, win_bases)
+                    batch = {"centers": dp.centers.reshape(-1),
+                             "contexts": dp.contexts.reshape(-1),
+                             "mask": dp.mask.reshape(-1)}
+                    new_p, metrics = inner(p, batch, negs, alpha)
+                    new_p = jax.lax.with_sharding_constraint(
+                        new_p, EmbeddingPair(emb_sharding, emb_sharding))
+                    return new_p, (metrics, dp.dropped_pairs.sum())
+
+                return jax.lax.scan(body, params, (arrays, alphas, nvalid, negatives))
+
+            return jax.jit(device_chunk, donate_argnums=(0,))
+
         def chunk(params, arrays, meta, base_step, prob, alias):
             # scan over steps_per_dispatch stacked batches in one device dispatch:
             # per-step dispatch/transfer latency (large through a remote-TPU tunnel)
@@ -464,6 +565,10 @@ class Trainer:
             return self._fit_sharded(
                 sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
                 total_words, K)
+        if cfg.device_pairgen:
+            return self._fit_device_feed(
+                sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
+                total_words, float(train_words), K)
         if self.state.shard_progress is not None and not self.state.finished:
             # batches_done from a sharded-input run counts B/N-pair local-shard
             # batches — applying it to the full replicated stream would silently
@@ -540,8 +645,17 @@ class Trainer:
         # The reference pipelines one minibatch ahead of its RPC round-trips for the
         # same reason (mllib:428-429): host work must overlap accelerator work. Here a
         # producer thread keeps a bounded buffer of ready chunks; numpy releases the
-        # GIL in its hot loops, so production genuinely overlaps dispatch.
-        if cfg.prefetch_chunks > 0:
+        # GIL in its hot loops, so production genuinely overlaps dispatch. Device
+        # staging rides the same thread (_stage_to_device) so the feed's wire
+        # transfer overlaps device compute too — single-process prefetching only:
+        # multi-process runs must keep one cross-host dispatch order (see
+        # _stage_to_device), and with prefetch off the put stays in the consumer so
+        # the host-wait/dispatch split keeps its documented meaning.
+        staged = cfg.prefetch_chunks > 0 and jax.process_count() == 1
+        if staged:
+            chunks = _threaded_iter(
+                self._stage_to_device(chunk_stream()), cfg.prefetch_chunks)
+        elif cfg.prefetch_chunks > 0:
             chunks = _threaded_iter(chunk_stream(), cfg.prefetch_chunks)
         else:
             chunks = chunk_stream()
@@ -556,7 +670,8 @@ class Trainer:
                 if chunk is None:
                     break
                 t0 = time.perf_counter()
-                stacked = put_global(self._chunk_shardings, chunk["arrays"])
+                stacked = (chunk["arrays"] if staged else
+                           put_global(self._chunk_shardings, chunk["arrays"]))
                 real = chunk["real"]
                 self.params, metrics = self._step_fn(
                     self.params, stacked, chunk["meta"],
@@ -583,9 +698,297 @@ class Trainer:
             self.save_checkpoint(checkpoint_path)
         return self.params
 
+    def _fit_device_feed(
+        self,
+        sentences: Sequence[np.ndarray],
+        checkpoint_path: Optional[str],
+        checkpoint_every_steps: Optional[int],
+        on_heartbeat: Optional[Callable[[HeartbeatRecord], None]],
+        total_words: float,
+        train_words: float,
+        K: int,
+    ) -> EmbeddingPair:
+        """fit() for the on-device pair generator (config.device_pairgen).
+
+        The host packs whole sentences into fixed [T]-token blocks per (step,
+        data-segment) and ships raw tokens + packed sentence-start bits + ordinal
+        bases — ~2.1 bytes/token ≈ 1 byte/pair vs 4 for packed pairs. Subsampling
+        and window expansion happen inside the jitted chunk (ops/pairgen.py, same
+        hash lattice → bit-identical stream). The lr clock advances on the
+        *expected* kept-word count per step (keep_prob summed over shipped tokens) —
+        deterministic, and no worse an approximation than the reference's
+        ``numPartitions · wordCount`` clock (mllib:406-410); exact trained-pair and
+        dropped-pair totals come back from the device at the end of the run.
+        """
+        cfg = self.config
+        from glint_word2vec_tpu.data.hashrng import (
+            STREAM_SUBSAMPLE, STREAM_WINDOW, stream_base)
+        from glint_word2vec_tpu.data.pipeline import stream_rng
+        Sd = self.plan.num_data
+        T = self._tokens_per_step
+        tok_dt = self._pair_dtype
+        keep = self._keep_host
+        B = cfg.pairs_per_batch
+        if self.state.shard_progress is not None and not self.state.finished:
+            raise ValueError(
+                "checkpoint was written by a sharded-input multi-process run; "
+                "resume it with the same process count, not with device_pairgen")
+        start_iter = self.state.iteration
+        skip_steps = self.state.batches_done if not self.state.finished else 0
+        # analytic pairs/step estimate — heartbeat display only; exact totals come
+        # back from the device (see end of method)
+        b = np.arange(cfg.window, dtype=np.float64)
+        rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()
+
+        def seg_blocks(k: int, s: int):
+            """[T]-token blocks of segment s, iteration k — SUBSAMPLED on the host
+            (same hashrng draws on raw ordinals as data/pipeline, vectorized over
+            ~1M-raw-token slabs; a per-sentence Python loop measurably starved the
+            feed), so the wire carries only kept tokens and the lr clock is exact.
+            The kept stream is cut at T boundaries — a sentence straddling a cut
+            loses its cross-cut window context, the same class of boundary as the
+            reference's maxSentenceLength chunking (mllib:341); at production T
+            (tens of thousands) that is ~0.02% of windows. Yields
+            (tokens[T], start_bits, n_valid, kept_ordinal_base, kept_count)."""
+            from glint_word2vec_tpu.data.hashrng import hash_u01_at
+            rng = stream_rng(cfg.seed, k, s)
+            order = np.arange(s, len(sentences), Sd)
+            if cfg.shuffle:
+                rng.shuffle(order)
+            sub_base = stream_base(cfg.seed, STREAM_SUBSAMPLE, k, s)
+            base, raw_ord = 0, 0
+            rest_tok = np.empty(0, tok_dt)
+            rest_start = np.empty(0, bool)
+
+            def emit(toks, starts):
+                n = toks.shape[0]
+                buf = np.zeros(T, tok_dt)
+                buf[:n] = toks
+                bits = np.packbits(np.pad(starts, (0, T - n)), bitorder="little")
+                return (buf, bits, n, base, float(n))
+
+            from glint_word2vec_tpu.data.pipeline import iter_sentence_slabs
+            for slab in iter_sentence_slabs(sentences, order):
+                tokens = np.concatenate(slab) if len(slab) > 1 else slab[0]
+                lens = np.fromiter((x.shape[0] for x in slab), np.int64, len(slab))
+                n = tokens.shape[0]
+                sids = np.repeat(np.arange(len(slab)), lens)
+                if cfg.subsample_ratio > 0:
+                    u = hash_u01_at(sub_base, np.arange(
+                        raw_ord, raw_ord + n, dtype=np.uint64))
+                    m = u <= keep[tokens]
+                    ktoks, ksids = tokens[m], sids[m]
+                else:
+                    ktoks, ksids = tokens, sids
+                raw_ord += n
+                if ktoks.shape[0] == 0:
+                    continue
+                kstart = np.empty(ktoks.shape[0], bool)
+                kstart[0] = True
+                kstart[1:] = ksids[1:] != ksids[:-1]
+                rest_tok = np.concatenate([rest_tok, ktoks.astype(tok_dt)])
+                rest_start = np.concatenate([rest_start, kstart])
+                while rest_tok.shape[0] >= T:
+                    yield emit(rest_tok[:T], rest_start[:T])
+                    base += T
+                    rest_tok = rest_tok[T:]
+                    rest_start = rest_start[T:].copy()
+                    if rest_start.shape[0]:
+                        # the cut tail acts as a new sentence (device treats the
+                        # leading run of a block as one regardless)
+                        rest_start[0] = True
+            if rest_tok.shape[0]:
+                yield emit(rest_tok, rest_start)
+
+        def chunk_stream():
+            for k in range(start_iter, cfg.num_iterations + 1):
+                prev_words = (k - 1) * train_words
+                sub_bases = np.asarray(
+                    [stream_base(cfg.seed, STREAM_SUBSAMPLE, k, s)
+                     for s in range(Sd)], np.uint32)
+                win_bases = np.asarray(
+                    [stream_base(cfg.seed, STREAM_WINDOW, k, s)
+                     for s in range(Sd)], np.uint32)
+                iters = [seg_blocks(k, s) for s in range(Sd)]
+                clock = 0.0
+                steps_in_iter = skip_steps if k == start_iter else 0
+                to_skip = skip_steps if k == start_iter else 0
+                pending: List[tuple] = []
+                pending_words: List[float] = []
+
+                def flush():
+                    nonlocal pending, pending_words, steps_in_iter
+                    real = len(pending)
+                    while len(pending) < K:
+                        pending.append((np.zeros((Sd, T), tok_dt),
+                                        np.zeros((Sd, (T + 7) // 8), np.uint8),
+                                        np.zeros(Sd, np.float32),
+                                        np.zeros((Sd, 2), np.int32), 0.0))
+                        pending_words.append(pending_words[-1])
+                    arrays = {
+                        "tokens": np.stack([p[0] for p in pending]),
+                        "starts": np.stack([p[1] for p in pending]),
+                        "obase": np.stack([p[3] for p in pending]),
+                    }
+                    nvalid = np.stack([p[2] for p in pending])       # [K, Sd]
+                    alphas = np.asarray([
+                        alpha_schedule(w, total_words, cfg.learning_rate,
+                                       cfg.min_alpha_factor)
+                        for w in pending_words], np.float32)
+                    meta = np.concatenate([alphas[None, :], nvalid.T])  # [1+Sd, K]
+                    est_pairs = sum(p[4] for p in pending) * rate_per_kept
+                    steps_in_iter += real
+                    out = dict(
+                        arrays=arrays, meta=meta, real=real, iteration=k,
+                        words_processed=int(pending_words[real - 1]),
+                        batches_done=steps_in_iter, est_pairs=est_pairs,
+                        sub_bases=sub_bases, win_bases=win_bases)
+                    pending, pending_words = [], []
+                    return out
+
+                while True:
+                    step_rows = []
+                    exp_kept = 0.0
+                    exhausted = 0
+                    for it in iters:
+                        blk = next(it, None)
+                        if blk is None:
+                            exhausted += 1
+                            step_rows.append((np.zeros(T, tok_dt),
+                                              np.zeros((T + 7) // 8, np.uint8),
+                                              0, 0, 0.0))
+                        else:
+                            step_rows.append(blk)
+                            exp_kept += blk[4]
+                    if exhausted == Sd:
+                        break
+                    clock += exp_kept
+                    if to_skip:
+                        to_skip -= 1
+                        continue
+                    tokens = np.stack([r[0] for r in step_rows])
+                    starts = np.stack([r[1] for r in step_rows])
+                    nvalid = np.asarray([r[2] for r in step_rows], np.float32)
+                    obase = np.asarray(
+                        [[r[3] & 0xFFFFFFFF, r[3] >> 32] for r in step_rows],
+                        np.uint32).view(np.int32)
+                    pending.append((tokens, starts, nvalid, obase,
+                                    exp_kept))
+                    pending_words.append(prev_words + clock)
+                    if len(pending) == K:
+                        yield flush()
+                if pending:
+                    yield flush()
+
+        staged = cfg.prefetch_chunks > 0  # device_pairgen is single-process only
+        if staged:
+            chunks = _threaded_iter(
+                self._stage_to_device(chunk_stream()), cfg.prefetch_chunks)
+        else:
+            chunks = chunk_stream()
+
+        self._start_run_bookkeeping()
+        chunks = iter(chunks)
+        pairs_arrays: List[jax.Array] = []      # [K] per chunk, summed at the end
+        dropped_arrays: List[jax.Array] = []
+        est_total = 0.0
+        try:
+            while True:
+                t0 = time.perf_counter()
+                chunk = next(chunks, None)
+                self.host_wait_time += time.perf_counter() - t0
+                if chunk is None:
+                    break
+                t0 = time.perf_counter()
+                stacked = (chunk["arrays"] if staged else
+                           put_global(self._chunk_shardings, chunk["arrays"]))
+                real = chunk["real"]
+                self.params, (metrics, dropped) = self._step_fn(
+                    self.params, stacked, chunk["meta"],
+                    np.int32(self.global_step + 1),
+                    self._table_prob, self._table_alias,
+                    self._keep_prob_dev, chunk["sub_bases"], chunk["win_bases"])
+                self.dispatch_time += time.perf_counter() - t0
+                pairs_arrays.append(metrics.pairs)
+                dropped_arrays.append(dropped)
+                est_total += chunk["est_pairs"]
+                self._finish_round(
+                    real, chunk["est_pairs"], chunk["meta"][0], metrics,
+                    TrainState(iteration=chunk["iteration"],
+                               words_processed=chunk["words_processed"],
+                               batches_done=chunk["batches_done"]),
+                    checkpoint_path, checkpoint_every_steps, on_heartbeat)
+        finally:
+            self._stop_profiler()
+            closer = getattr(chunks, "close", None)
+            if closer is not None:
+                closer()
+
+        if pairs_arrays:
+            exact = float(jnp.concatenate(pairs_arrays).sum())
+            dropped_total = float(jnp.stack(dropped_arrays).sum())
+            # heartbeats ran on the analytic estimate; settle the books exactly
+            self.pairs_trained += exact - est_total
+            self._pairs_since_log = max(
+                self._pairs_since_log + exact - est_total, 0.0)
+            if dropped_total > 0.02 * max(exact, 1.0):
+                logger.warning(
+                    "device pairgen dropped %.0f pairs (%.1f%% of %.0f trained) to "
+                    "overflow — raise tokens_per_step (or lower pairs_per_batch "
+                    "fill pressure)", dropped_total,
+                    100.0 * dropped_total / exact, exact)
+            elif dropped_total:
+                logger.info("device pairgen: %.0f overflow pairs dropped "
+                            "(%.3f%%)", dropped_total,
+                            100.0 * dropped_total / max(exact, 1.0))
+
+        self.state = TrainState(
+            iteration=cfg.num_iterations,
+            words_processed=int(cfg.num_iterations * train_words),
+            finished=True, global_step=self.global_step)
+        if checkpoint_path:
+            self.save_checkpoint(checkpoint_path)
+        return self.params
+
+    def _stage_to_device(self, chunks):
+        """Generator stage: place each chunk's feed arrays on device and dispatch a
+        tiny consuming op so the host→device wire transfer happens HERE — on the
+        producer thread when prefetching — overlapped with the main thread's step
+        dispatches. Through a thin link (remote-TPU tunnel, DCN feed) argument
+        upload is otherwise lazy and serializes with compute at dispatch time
+        (measured: a concurrent put+consume fully hides behind device compute,
+        a consumer-thread put does not).
+
+        Single-process only: with multiple processes, a producer-thread dispatch
+        would race the main thread's step dispatch for cross-host program launch
+        order and can deadlock the collectives — multi-process feeds keep the
+        consumer-thread put (callers gate on process_count)."""
+        if not hasattr(self, "_touch_fn"):
+            import operator
+
+            def touch(arrays):
+                return jax.tree.reduce(
+                    operator.add,
+                    jax.tree.map(
+                        lambda x: x.reshape(-1)[:1].astype(jnp.float32).sum(),
+                        arrays))
+
+            self._touch_fn = jax.jit(touch)
+        for chunk in chunks:
+            stacked = put_global(self._chunk_shardings, chunk["arrays"])
+            chunk["arrays"] = stacked
+            # retain the forcing op's output with the chunk (never fetched — a
+            # blocking fetch here stalls the producer behind the device queue,
+            # measured slower; the dispatch is enough to enqueue the upload)
+            chunk["_touch"] = self._touch_fn(stacked)
+            yield chunk
+
     def _start_run_bookkeeping(self) -> None:
-        self.host_wait_time = 0.0      # fit() blocked on batch production
-        self.dispatch_time = 0.0       # fit() inside transfer + (async) step dispatch
+        self.host_wait_time = 0.0      # fit() blocked on batch production (incl. the
+                                       # producer's device staging when prefetching)
+        self.dispatch_time = 0.0       # fit() inside (async) step dispatch; also the
+                                       # feed transfer when prefetch_chunks=0 (no
+                                       # producer thread to stage on)
         self._last_log_time = time.perf_counter()
         self._last_log_step = self.global_step
         self._pairs_since_log = 0.0

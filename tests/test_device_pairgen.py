@@ -1,0 +1,144 @@
+"""Device-side pair generation (ops/pairgen.py) vs the host pipeline: bit-identical.
+
+The device stream must reproduce data/pipeline._block_pairs exactly — same murmur3
+position-keyed draws (data/hashrng.py contract), same subsample rule (mllib:371-379
+intended semantics), same legacy asymmetric window (mllib:384-388) — so switching the
+feed to raw token blocks never changes training results.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.data.hashrng import (
+    STREAM_SUBSAMPLE, STREAM_WINDOW, stream_base)
+from glint_word2vec_tpu.data.pipeline import _block_pairs, keep_probabilities
+from glint_word2vec_tpu.ops.pairgen import device_block_pairs, pack_start_bits
+
+V = 500
+WINDOW = 5
+
+
+def _mk_corpus(rng, n_sent, max_len):
+    lengths = rng.integers(1, max_len, n_sent)
+    tokens = rng.integers(0, V, int(lengths.sum())).astype(np.int32)
+    return tokens, lengths.astype(np.int64)
+
+
+def _host(tokens, lengths, keep, seed, iteration, shard, token_base,
+          legacy=True):
+    return _block_pairs(tokens, lengths, keep, WINDOW, seed, iteration, shard,
+                        token_base, legacy)
+
+
+def _device(tokens, lengths, keep, seed, iteration, shard, token_base, T, B,
+            legacy=True):
+    N = tokens.shape[0]
+    padded = np.zeros(T, np.int32)
+    padded[:N] = tokens
+    bits = pack_start_bits(lengths, T)
+    sub = stream_base(seed, STREAM_SUBSAMPLE, iteration, shard)
+    win = stream_base(seed, STREAM_WINDOW, iteration, shard)
+    fn = jax.jit(device_block_pairs, static_argnames=(
+        "window", "num_pairs", "legacy_asymmetric_window"))
+    return fn(
+        jnp.asarray(padded), jnp.asarray(bits), jnp.int32(N),
+        jnp.uint32(token_base & 0xFFFFFFFF), jnp.uint32(token_base >> 32),
+        jnp.asarray(keep, jnp.float32), jnp.uint32(sub), jnp.uint32(win),
+        window=WINDOW, num_pairs=B, legacy_asymmetric_window=legacy)
+
+
+@pytest.mark.parametrize("subsample", [0.0, 1e-2])
+@pytest.mark.parametrize("legacy", [True, False])
+def test_device_stream_bit_identical_to_host(subsample, legacy):
+    rng = np.random.default_rng(0)
+    counts = np.maximum(1000 / (np.arange(V) + 2.0), 1.0)
+    keep = keep_probabilities(counts, int(counts.sum()), subsample)
+    tokens, lengths = _mk_corpus(rng, n_sent=60, max_len=30)
+    hc, hx, _, hkept = _host(tokens, lengths, keep, seed=7, iteration=2,
+                             shard=0, token_base=0, legacy=legacy)
+    out = _device(tokens, lengths, keep, seed=7, iteration=2, shard=0,
+                  token_base=0, T=1024, B=4096, legacy=legacy)
+    n = int(out.mask.sum())
+    assert n == hc.shape[0]
+    np.testing.assert_array_equal(np.asarray(out.centers[:n]), hc)
+    np.testing.assert_array_equal(np.asarray(out.contexts[:n]), hx)
+    assert int(out.kept_words) == hkept
+    assert int(out.dropped_pairs) == 0
+    # masked tail is zeroed
+    assert np.all(np.asarray(out.centers[n:]) == 0)
+
+
+def test_device_stream_nonzero_token_base_matches_host():
+    """Ordinal continuity: a later block (token_base > 0, incl. > 2^32 for the carry
+    path) draws exactly the host's subsample/window decisions."""
+    rng = np.random.default_rng(1)
+    counts = np.maximum(1000 / (np.arange(V) + 2.0), 1.0)
+    keep = keep_probabilities(counts, int(counts.sum()), 1e-2)
+    tokens, lengths = _mk_corpus(rng, n_sent=40, max_len=25)
+    for base in (12_345, (1 << 32) - 100):  # the second straddles the carry
+        hc, hx, _, hkept = _host(tokens, lengths, keep, seed=3, iteration=1,
+                                 shard=2, token_base=base)
+        out = _device(tokens, lengths, keep, seed=3, iteration=1, shard=2,
+                      token_base=base, T=1024, B=4096)
+        n = int(out.mask.sum())
+        assert n == hc.shape[0]
+        np.testing.assert_array_equal(np.asarray(out.centers[:n]), hc)
+        np.testing.assert_array_equal(np.asarray(out.contexts[:n]), hx)
+        assert int(out.kept_words) == hkept
+
+
+def test_device_overflow_drops_tail_pairs():
+    """More window pairs than B slots: the first B pairs of the host stream are
+    emitted, the remainder is counted in dropped_pairs."""
+    rng = np.random.default_rng(2)
+    keep = np.ones(V)
+    tokens, lengths = _mk_corpus(rng, n_sent=50, max_len=30)
+    hc, hx, _, _ = _host(tokens, lengths, keep, seed=1, iteration=1, shard=0,
+                         token_base=0)
+    B = hc.shape[0] // 2
+    out = _device(tokens, lengths, keep, seed=1, iteration=1, shard=0,
+                  token_base=0, T=2048, B=B)
+    assert int(out.mask.sum()) == B
+    np.testing.assert_array_equal(np.asarray(out.centers), hc[:B])
+    np.testing.assert_array_equal(np.asarray(out.contexts), hx[:B])
+    assert int(out.dropped_pairs) == hc.shape[0] - B
+
+
+def test_split_blocks_concatenate_to_host_stream():
+    """Two consecutive device blocks (whole-sentence packing, ordinal bases carried
+    like the trainer does) concatenate to the host stream over the full corpus."""
+    rng = np.random.default_rng(3)
+    counts = np.maximum(1000 / (np.arange(V) + 2.0), 1.0)
+    keep = keep_probabilities(counts, int(counts.sum()), 5e-3)
+    tokens, lengths = _mk_corpus(rng, n_sent=50, max_len=30)
+    hc, hx, _, _ = _host(tokens, lengths, keep, seed=9, iteration=1, shard=0,
+                         token_base=0)
+    # split at a sentence boundary near the middle
+    s_half = len(lengths) // 2
+    n1 = int(lengths[:s_half].sum())
+    parts = []
+    for toks, lens, base in (
+            (tokens[:n1], lengths[:s_half], 0),
+            (tokens[n1:], lengths[s_half:], n1)):
+        out = _device(toks, lens, keep, seed=9, iteration=1, shard=0,
+                      token_base=base, T=1024, B=4096)
+        n = int(out.mask.sum())
+        parts.append((np.asarray(out.centers[:n]), np.asarray(out.contexts[:n])))
+    np.testing.assert_array_equal(np.concatenate([p[0] for p in parts]), hc)
+    np.testing.assert_array_equal(np.concatenate([p[1] for p in parts]), hx)
+
+
+def test_empty_and_all_dropped_blocks():
+    keep = np.zeros(V)  # drop everything
+    tokens = np.arange(20, dtype=np.int32) % V
+    lengths = np.asarray([10, 10], np.int64)
+    out = _device(tokens, lengths, keep, seed=0, iteration=1, shard=0,
+                  token_base=0, T=64, B=128)
+    assert int(out.mask.sum()) == 0
+    assert int(out.kept_words) == 0
+    # zero valid tokens at all
+    out = _device(np.empty(0, np.int32), np.empty(0, np.int64), np.ones(V),
+                  seed=0, iteration=1, shard=0, token_base=0, T=64, B=128)
+    assert int(out.mask.sum()) == 0
