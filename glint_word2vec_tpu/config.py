@@ -82,11 +82,17 @@ class Word2VecConfig:
                                      # tiny-vocab/large-batch regimes where summed
                                      # duplicates would diverge (slows differentiation;
                                      # see ops/sgns.py)
-    negative_pool: int = 0          # >0: share one pool of this many negatives across the
+    negative_pool: int = -1         # >0: share one pool of this many negatives across the
                                     # whole batch (reweighted by negatives/pool to keep the
                                     # expected gradient) — turns the dominant negative row
                                     # traffic into MXU matmuls, ~2-3x step speedup. 0 = the
-                                    # reference's exact per-pair sampling (G3 semantics)
+                                    # reference's exact per-pair sampling (G3 semantics;
+                                    # the compat layer pins this). -1 (default) = AUTO:
+                                    # resolved at construction to the smallest multiple of
+                                    # 128 keeping the pool-row load pairs_per_batch *
+                                    # negatives / pool <= 600 — the measured 60M-word
+                                    # stability rule (EVAL.md; a fixed small pool under a
+                                    # large batch provably diverges, e.g. B=64k/P=64)
     pad_vector_to_lanes: bool = True  # pad the embedding minor dim to a multiple of 128
                                       # (TPU lane width) — D=300 rows are misaligned and
                                       # measurably slower than padded 384; exports are
@@ -198,9 +204,19 @@ class Word2VecConfig:
         if self.num_model_shards <= 0:
             raise ValueError(
                 f"num_model_shards must be positive but got {self.num_model_shards}")
+        # remembered so replace() re-derives the pool when the batch geometry
+        # changes (a resolved auto pool must not stick to a new pairs_per_batch)
+        self._auto_pool = self.negative_pool == -1
+        if self.negative_pool == -1:
+            # AUTO: scale the shared pool with the batch so the per-row load stays
+            # inside the measured 60M-word stability boundary (load <= 600, EVAL.md),
+            # rounded up to the 128-lane MXU tile
+            p_min = -(-self.pairs_per_batch * self.negatives // 600)
+            self.negative_pool = max(128, 128 * (-(-p_min // 128)))
         if self.negative_pool < 0:
             raise ValueError(
-                f"negative_pool must be nonnegative but got {self.negative_pool}")
+                f"negative_pool must be nonnegative (or -1 for auto) "
+                f"but got {self.negative_pool}")
         if self.num_data_shards <= 0:
             raise ValueError(
                 f"num_data_shards must be positive but got {self.num_data_shards}")
@@ -213,6 +229,11 @@ class Word2VecConfig:
                 f"tokens_per_step must be nonnegative but got {self.tokens_per_step}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
+        if (getattr(self, "_auto_pool", False) and "negative_pool" not in kwargs
+                and ("pairs_per_batch" in kwargs or "negatives" in kwargs)):
+            # the pool was auto-derived from the OLD batch geometry — re-derive it
+            # for the new one instead of freezing a now-undersized pool
+            kwargs["negative_pool"] = -1
         return dataclasses.replace(self, **kwargs)
 
     def to_dict(self) -> dict:

@@ -192,6 +192,10 @@ class ServerSideGlintWord2Vec:
             batch_size=self._batch_size,
             negatives=self._n,
             subsample_ratio=self._subsample_ratio,
+            # the reference samples n negatives per pair server-side (G3,
+            # mllib:419-421) — pin the exact per-pair path rather than inheriting
+            # the TPU-native config's auto-scaled shared pool
+            negative_pool=0,
             num_model_shards=min(n_shards, n_dev),
             unigram_table_size=self._unigram_table_size,
             seed=self._seed,

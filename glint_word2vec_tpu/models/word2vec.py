@@ -61,7 +61,11 @@ class Word2VecModel:
             # placed (the streaming load_params_into_plan path) are used as-is — no
             # host round-trip.
             placed = (isinstance(syn0, jax.Array) and syn0.shape[0] == Vp
-                      and syn0.sharding.is_equivalent_to(plan.embedding, 2))
+                      and syn0.sharding.is_equivalent_to(plan.embedding, 2)
+                      and (syn1 is None or (
+                          isinstance(syn1, jax.Array)
+                          and syn1.shape[0] == Vp
+                          and syn1.sharding.is_equivalent_to(plan.embedding, 2))))
             if not placed:
                 syn0 = jnp.asarray(syn0)
                 syn1 = jnp.asarray(syn1) if syn1 is not None else None
@@ -268,9 +272,39 @@ class Word2VecModel:
 
     def to_local(self) -> Tuple[List[str], np.ndarray]:
         """Dense host-side export (words, matrix) — the ``toLocal`` analog
-        (mllib:651-662) without the Spark model wrapper."""
+        (mllib:651-662) without the Spark model wrapper. For the ecosystem
+        hand-off the reference's Spark ``Word2VecModel`` provided (usable by
+        downstream tooling), see :meth:`export_word2vec`."""
         self._check_alive()
         return list(self.vocab.words), np.asarray(self.syn0)
+
+    def export_word2vec(self, path: str, binary: bool = False,
+                        batch_size: int = 65536) -> None:
+        """Write the classic word2vec vectors file — the ecosystem interop the
+        reference's ``toLocal`` delivers by producing a stock Spark model
+        (mllib:651-662): gensim ``KeyedVectors.load_word2vec_format``, fastText
+        tooling, and the original word2vec.c distance tools all read this.
+
+        Format (word2vec.c's writer): header line ``"<vocab> <dim>\\n"``; then per
+        word, ``word`` + ``' '`` + (text: space-joined decimals + ``'\\n'``;
+        binary: dim little-endian float32s followed by ``'\\n'``). Streams in row
+        blocks — no full-matrix host copy beyond one block."""
+        self._check_alive()
+        D = int(self.syn0.shape[1])
+        with open(path, "wb") as f:
+            f.write(f"{self.num_words} {D}\n".encode())
+            for start in range(0, self.num_words, batch_size):
+                stop = min(start + batch_size, self.num_words)
+                block = np.asarray(self.syn0[start:stop], np.float32)
+                if binary:
+                    for i in range(stop - start):
+                        f.write(self.vocab.words[start + i].encode() + b" ")
+                        f.write(block[i].astype("<f4").tobytes())
+                        f.write(b"\n")
+                else:
+                    for i in range(stop - start):
+                        vec = " ".join(repr(float(x)) for x in block[i])
+                        f.write(f"{self.vocab.words[start + i]} {vec}\n".encode())
 
     # -- persistence (G9/C13) ----------------------------------------------------------
 

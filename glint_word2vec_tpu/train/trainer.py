@@ -313,23 +313,28 @@ class Trainer:
 
         return EmbeddingPair(syn0=pad(params.syn0), syn1=pad(params.syn1))
 
-    def _stability_warnings(self) -> None:
+    def _stability_warnings(self, check_pool: bool = True) -> None:
         """Large synchronous batches can diverge through two per-step row-overload
         channels the reference's tiny async minibatches never hit (measured, EVAL.md):
 
         - POOL load ``B·n/P``: every pool row absorbs the negative gradient of all B
           pairs scaled by n/P. B=64k/P=64 (load 5120) trains to NaN at lr 0.025; the
           same run at P=256 (load 1280) is stable with the best quality of the sweep.
+          The config default auto-scales the pool to load ≤ 600, so this fires only
+          on explicit pool choices.
         - DUPLICATE load ``B·max_word_share``: a frequent word's context occurrences
           scatter-add summed updates. With no subsampling the top Zipf word is ~1% of
           pairs (~650 summed updates at B=64k) and training explodes even at small
           pool loads; frequency subsampling (≈1e-4) or duplicate_scaling bounds it.
+          This channel also hits the per-pair (negative_pool=0) paths — they get
+          ``check_pool=False``.
         """
         cfg = self.config
         if cfg.duplicate_scaling:
             return  # mean-update semantics bound both channels by construction
         pool = cfg.negative_pool if cfg.negative_pool > 0 else 64  # pallas substitute
-        pool_load = cfg.pairs_per_batch * cfg.negatives / pool
+        pool_load = (cfg.pairs_per_batch * cfg.negatives / pool if check_pool
+                     else 0.0)
         if pool_load > 2000:
             logger.warning(
                 "pairs_per_batch*negatives/negative_pool = %.0f > 2000: pool-row "
@@ -427,11 +432,12 @@ class Trainer:
 
             neg_shape = shared_pool_shape
         elif cfg.cbow:
-            if cfg.negative_pool > 0:
+            if cfg.negative_pool > 0 and not getattr(cfg, "_auto_pool", False):
                 logger.warning(
                     "negative_pool is ignored for CBOW with duplicate_scaling=True "
                     "(mean semantics are only implemented per-example); using "
                     "per-example negative sampling")
+            self._stability_warnings(check_pool=False)
 
             def inner(params, batch, negatives, alpha):
                 return cbow_step_core(
@@ -441,6 +447,11 @@ class Trainer:
 
             neg_shape = lambda K, B: (K, B, cfg.negatives)  # noqa: E731
         else:
+            # per-pair path (negative_pool=0): no shared pool, but the duplicate
+            # overload channel still applies (summed scatter-adds of a frequent
+            # word's updates — the EVAL.md regime)
+            self._stability_warnings(check_pool=False)
+
             def inner(params, batch, negatives, alpha):
                 return sgns_step_core(
                     params, batch["centers"], batch["contexts"], batch["mask"],
@@ -1227,7 +1238,11 @@ class Trainer:
                     TrainState(
                         iteration=int(g["prog"][:, 0].min()),
                         words_processed=int(clock),
-                        batches_done=cur_batches,
+                        # batches_done is meaningless across shards (each process's
+                        # local stream advances at its own rate); sharded-input
+                        # resume MUST use shard_progress, so persist 0 here rather
+                        # than the writing process's local count
+                        batches_done=0,
                         shard_progress=[[int(a), int(b_)] for a, b_ in g["prog"]]),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
         finally:

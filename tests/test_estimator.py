@@ -343,6 +343,28 @@ def test_stability_warnings_fire(caplog):
     msgs = warns(pairs_per_batch=65536, negatives=5, negative_pool=256,
                  subsample_ratio=1e-4)
     assert any("compound" in m for m in msgs), msgs
+    # the duplicate channel is warned on the per-pair path too (negative_pool=0)
+    assert any("duplicates" in m for m in warns(
+        pairs_per_batch=65536, negatives=5, negative_pool=0))
     # a safe config stays quiet
     assert not warns(pairs_per_batch=16384, negatives=5, negative_pool=64,
                      subsample_ratio=1e-4)
+
+
+def test_auto_negative_pool_scales_with_batch():
+    """The default (negative_pool=-1) resolves so pool load B*n/P stays <= 600 —
+    the measured 60M-word stability rule (EVAL.md) — rounded to the 128 lane tile."""
+    from glint_word2vec_tpu.config import Word2VecConfig
+
+    cfg = Word2VecConfig(pairs_per_batch=65536)
+    assert cfg.negative_pool >= 512
+    assert cfg.negative_pool % 128 == 0
+    assert 65536 * cfg.negatives / cfg.negative_pool <= 600
+    small = Word2VecConfig(pairs_per_batch=8192)
+    assert small.negative_pool == 128
+    # explicit choices pass through untouched; 0 keeps the per-pair path
+    assert Word2VecConfig(negative_pool=256).negative_pool == 256
+    assert Word2VecConfig(negative_pool=0).negative_pool == 0
+    # the compat layer pins the reference's exact per-pair semantics
+    from glint_word2vec_tpu.models.compat import ServerSideGlintWord2Vec
+    assert ServerSideGlintWord2Vec().to_config().negative_pool == 0

@@ -121,6 +121,46 @@ def test_to_local(model):
     np.testing.assert_allclose(mat, syn0, rtol=1e-6)
 
 
+def _read_word2vec_format(path, binary):
+    """Reference reader for the classic word2vec format — parses exactly the way
+    gensim's KeyedVectors.load_word2vec_format / word2vec.c's distance tool do:
+    header "<vocab> <dim>", then per word either space-joined decimals + newline
+    (text) or <dim> little-endian float32s + newline (binary, word ends at ' ')."""
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        v, d = int(header[0]), int(header[1])
+        words, vecs = [], np.empty((v, d), np.float32)
+        for i in range(v):
+            if binary:
+                w = bytearray()
+                while True:
+                    ch = f.read(1)
+                    if ch == b" ":
+                        break
+                    w.extend(ch)
+                words.append(w.decode())
+                vecs[i] = np.frombuffer(f.read(4 * d), dtype="<f4")
+                assert f.read(1) == b"\n"
+            else:
+                parts = f.readline().split()
+                words.append(parts[0].decode())
+                vecs[i] = [float(x) for x in parts[1:]]
+    return words, vecs
+
+
+@pytest.mark.parametrize("binary", [False, True])
+def test_export_word2vec_round_trip(model, tmp_path, binary):
+    """export_word2vec writes the exact classic format (the reference's toLocal
+    ecosystem hand-off, mllib:651-662): a gensim-style parser reads back identical
+    words and float32-identical vectors."""
+    m, syn0 = model
+    path = str(tmp_path / ("vecs.bin" if binary else "vecs.txt"))
+    m.export_word2vec(path, binary=binary, batch_size=2)  # exercise block seams
+    words, vecs = _read_word2vec_format(path, binary)
+    assert words == WORDS
+    np.testing.assert_array_equal(vecs, syn0.astype(np.float32))
+
+
 def test_vocab_size_mismatch_raises():
     vocab = Vocabulary.from_words_and_counts(["a"], [1])
     with pytest.raises(ValueError, match="rows"):

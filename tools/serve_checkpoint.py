@@ -60,13 +60,20 @@ def main():
         for i in range(attempts):
             try:
                 return Word2VecModel.load(args.checkpoint, plan=plan)
-            # only the transient swap-window failures: a missing path or
-            # half-written JSON. Permanent problems (bad --mesh for the shard
-            # layout, corrupt arrays) surface immediately instead of retrying.
-            except (FileNotFoundError, json.JSONDecodeError):
-                if i == attempts - 1:
+            # only the transient swap-window failures: a missing path, half-written
+            # JSON, or a metadata/words pair read across the two renames of the
+            # swap (surfaces as the loader's vocab_size-mismatch ValueError).
+            # Permanent problems (bad --mesh for the shard layout, corrupt arrays)
+            # surface immediately instead of retrying.
+            except (FileNotFoundError, json.JSONDecodeError) as e:
+                last = e
+            except ValueError as e:
+                if "vocab_size" not in str(e) and "words" not in str(e):
                     raise
-                time.sleep(delay)
+                last = e
+            if i == attempts - 1:
+                raise last
+            time.sleep(delay)
 
     model = load_with_retry()
 
