@@ -175,6 +175,70 @@ def bench_step(counts, b: int, pool: int, dtype: str = "float32",
     return pps, mfu
 
 
+def bench_cbow_step(counts, b: int, pool: int, param_dtype: str = "bfloat16",
+                    window: int = 5) -> tuple:
+    """CBOW shared-pool step (BASELINE config 5): grouped [B, 2w] context windows,
+    hidden = masked context mean, negatives from the shared pool."""
+    import jax
+    import jax.numpy as jnp
+    from microbench import time_chunked
+
+    from glint_word2vec_tpu.ops.sampler import build_alias_table, sample_negatives_hash
+    from glint_word2vec_tpu.ops.sgns import (
+        EmbeddingPair, cbow_step_shared_core, init_embeddings)
+
+    C = 2 * window
+    table = build_alias_table(counts)
+    prob, alias = table.prob, table.alias
+    pdt = jnp.dtype(param_dtype)
+    syn0_0 = init_embeddings(V, PAD_D, jax.random.key(0)).syn0.astype(pdt)
+    rng = np.random.default_rng(0)
+    syn1_0 = jnp.asarray(rng.standard_normal((V, PAD_D), np.float32) * 0.05, pdt)
+
+    def chunk(params, batches, base_step, prob, alias):
+        negs = sample_negatives_hash(prob, alias, 1234, base_step, (K, pool))
+
+        def body(p, inp):
+            batch, ng = inp
+            new_p, m = cbow_step_shared_core(
+                p, batch["centers"], batch["contexts"], batch["ctx_mask"],
+                batch["mask"], ng, jnp.float32(0.025), NEG, "exact", pdt,
+                jnp.bfloat16 if param_dtype == "bfloat16" else jnp.float32)
+            return new_p, m.loss
+
+        return jax.lax.scan(body, params, (batches, negs))
+
+    f = jax.jit(chunk, donate_argnums=(0,))
+    all_batches = []
+    for i in range(6):
+        r = np.random.default_rng(3000 + i)
+        nctx = r.integers(1, C + 1, (K, b))
+        all_batches.append({
+            "centers": jnp.asarray(_zipf_indices(r, (K, b)), jnp.int32),
+            "contexts": jnp.asarray(_zipf_indices(r, (K, b, C)), jnp.int32),
+            "ctx_mask": jnp.asarray(
+                np.arange(C)[None, None, :] < nctx[..., None], jnp.float32),
+            "mask": jnp.ones((K, b), jnp.float32),
+        })
+
+    ts = []
+    for _ in range(3):
+        spc = time_chunked(
+            lambda p, bt, base: f(p, bt, base, prob, alias),
+            make_carry=lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
+            args_for_iter=lambda i: (all_batches[i % 6], np.int32(100 + i)),
+            n_lo=2, n_hi=8, fetch=lambda c, out: out[-1])
+        ts.append(spc / K)
+    spp = float(np.median(ts))
+    # a CBOW "example" trains ~mean(nctx) positive word-context links; report
+    # examples/s (the step unit) and the links/s equivalent for pair comparison
+    eps = b / spp
+    log(f"step cbow {param_dtype[:4]:17s} V={V:8,d} B={b:6d} pool={pool:5d}: "
+        f"{spp * 1e3:7.3f} ms/step -> {eps:13,.0f} examples/s "
+        f"(~{eps * (C + 1) / 2:,.0f} word-link/s)")
+    return eps, 0.0
+
+
 def bench_e2e(device_pairgen: bool, param_dtype: str, logits_dtype: str,
               pool: int) -> tuple:
     """End-to-end Word2Vec-style fit on a synthetic Zipf corpus — includes vocab
@@ -338,6 +402,11 @@ def main() -> None:
                                    logits_dtype="bfloat16")
     rows["bf16_p1024"] = bench_step(counts, B_MAIN, 1024, dtype="bfloat16",
                                     param_dtype="bfloat16")
+    cbow_eps = None
+    try:
+        cbow_eps, _ = bench_cbow_step(counts, B_MAIN, E2E_POOL)
+    except Exception as e:
+        log(f"cbow step row failed: {type(e).__name__}: {e}")
     # frontier context ONLY: EVAL-measured divergent at training scale
     try:
         bench_step(counts, B_MAIN, 64, label_extra=" [UNSTABLE @64]")
@@ -392,6 +461,7 @@ def main() -> None:
         "v1m_step_pairs_per_sec": (round(scale["step_bf16_pairs_per_sec"])
                                    if "step_bf16_pairs_per_sec" in scale
                                    else None),
+        "cbow_examples_per_sec": round(cbow_eps) if cbow_eps else None,
     }
     print(json.dumps(result))
 

@@ -54,12 +54,19 @@ class Word2VecConfig:
     num_model_shards: int = 1       # ≈ numParameterServers (mllib:78,204-212): how many ways
                                     # the embedding rows are sharded over the mesh 'model' axis
     num_data_shards: int = 1        # data-parallel degree over the mesh 'data' axis
-    embedding_partition: str = "rows"  # "rows" (north-star: V/N rows per device) or
+    embedding_partition: str = "rows"  # "rows" (production: V/N rows per device) or
                                        # "cols" (CIKM'16: D/N columns per device,
                                        # partial dots + psum — the reference PS
-                                       # layout, G2/SURVEY §7.4). Identical math,
-                                       # different collective profile; row-shards
-                                       # checkpoints require "rows"
+                                       # layout, G2/SURVEY §7.4). Identical math
+                                       # (cross-layout loss check in the dryrun).
+                                       # "cols" is EXPERIMENTAL, single-host only:
+                                       # the design verdict (PERF.md §7) is that
+                                       # rows divides the per-update-row scatter
+                                       # bound by N and enables row-shards
+                                       # checkpoints, while cols only wins
+                                       # collective bytes below pool ≈ 2·D (its
+                                       # blowout case, per-pair sampling, is the
+                                       # reference's thin-network regime, not ICI)
     mesh_shape: Optional[Tuple[int, int]] = None  # explicit (data, model) mesh; default derives
                                                   # from num_data_shards × num_model_shards
 
@@ -143,8 +150,9 @@ class Word2VecConfig:
                                     # mllib:345) and per-round allgathers assemble the
                                     # global batch — host pipeline work scales 1/N with
                                     # hosts. False = every process regenerates the full
-                                    # stream (zero-coordination fallback). Skip-gram only;
-                                    # CBOW multi-process stays on the replicated feed.
+                                    # stream (zero-coordination fallback). Both skip-gram
+                                    # (packed pairs) and CBOW (centers/contexts/counts)
+                                    # feeds ride the same protocol.
     device_pairgen: bool = False    # generate training pairs ON DEVICE (ops/pairgen.py):
                                     # the host subsamples and ships kept-token blocks
                                     # (~1 byte/pair on the wire vs 4 for packed pairs)
@@ -185,6 +193,10 @@ class Word2VecConfig:
                 f"max_sentence_length must be positive but got {self.max_sentence_length}")
         if self.window <= 0:
             raise ValueError(f"window must be positive but got {self.window}")
+        if self.window > 127:
+            # CBOW context counts ship as uint8 (2*window slots) and the reference
+            # caps useful windows far below this anyway (default 5, mllib:251)
+            raise ValueError(f"window must be <= 127 but got {self.window}")
         if self.batch_size <= 0:
             raise ValueError(f"batch_size must be positive but got {self.batch_size}")
         if self.negatives <= 0:

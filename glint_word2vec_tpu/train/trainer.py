@@ -162,10 +162,14 @@ class Trainer:
         self.plan = plan
         if config.embedding_partition == "cols" and (
                 config.sharded_checkpoint or jax.process_count() > 1):
+            # design verdict, not a TODO (PERF.md §7): rows is the production
+            # layout — it divides the per-update-row scatter bound by the mesh
+            # size and owns whole rows for shard checkpoints; cols stays an
+            # experimental single-host option for the per-pair-sampling regime
             raise ValueError(
-                "embedding_partition='cols' is incompatible with row-shards "
-                "checkpoints (each process writes full rows); use 'rows' for "
-                "multi-process / sharded_checkpoint runs")
+                "embedding_partition='cols' is experimental and single-host only: "
+                "row-shards checkpoints and multi-process runs need each process "
+                "to own whole rows (design rationale: PERF.md §7); use 'rows'")
         self.padded_vocab = pad_vocab_for_sharding(vocab.size, plan.num_model)
         # Pad the minor dim to the TPU lane width: D=300 rows are misaligned and row
         # gathers/scatters measurably slower than at 384. Padded columns are zero-init and
@@ -218,7 +222,7 @@ class Trainer:
         if config.cbow:
             self._chunk_shardings = {"centers": plan.batch_stacked,
                                      "contexts": plan.ctx_stacked,
-                                     "ctx_mask": plan.ctx_stacked}
+                                     "nctx": plan.batch_stacked}
         else:
             self._chunk_shardings = {"pairs": plan.pairs_stacked}
         # Sharded input feed (the repartition analog, mllib:345): each process
@@ -226,7 +230,7 @@ class Trainer:
         # from per-process segments by a per-round allgather (see _fit_sharded). The
         # batch's B axis is composed of N per-process segments, each prefix-masked.
         self._feed_segments = 1
-        if config.shard_input and jax.process_count() > 1 and not config.cbow:
+        if config.shard_input and jax.process_count() > 1:
             n = jax.process_count()
             if config.pairs_per_batch % n:
                 raise ValueError(
@@ -537,9 +541,14 @@ class Trainer:
                 xs, alpha, real, negs = inp
                 mask = (pos[None, :] < real[:, None]).astype(jnp.float32).reshape(-1)
                 if is_cbow:
+                    ctx = xs["contexts"].astype(jnp.int32)
+                    # contexts are left-packed; the mask ships as a count (~40x
+                    # fewer feed bytes than a [B, C] float mask)
+                    nctx = xs["nctx"].astype(jnp.int32)
+                    ctx_mask = (jnp.arange(ctx.shape[-1])[None, :]
+                                < nctx[:, None]).astype(jnp.float32)
                     batch = {"centers": xs["centers"].astype(jnp.int32),
-                             "contexts": xs["contexts"].astype(jnp.int32),
-                             "ctx_mask": xs["ctx_mask"], "mask": mask}
+                             "contexts": ctx, "ctx_mask": ctx_mask, "mask": mask}
                 else:
                     prs = xs["pairs"].astype(jnp.int32)
                     batch = {"centers": prs[0], "contexts": prs[1], "mask": mask}
@@ -614,8 +623,19 @@ class Trainer:
                         pending_words.append(pending_words[-1])
                     reals = np.asarray([b["real"] for b in pending], np.float32)
                     if cfg.cbow:
-                        arrays = {name: np.stack([b[name] for b in pending])
-                                  for name in ("centers", "contexts", "ctx_mask")}
+                        # filled in place like the pairs branch below: stack+astype
+                        # double-copies measurably throttle the producer
+                        B0 = pending[0]["centers"].shape[0]
+                        C0 = pending[0]["contexts"].shape[1]
+                        arrays = {
+                            "centers": np.empty((K, B0), self._pair_dtype),
+                            "contexts": np.empty((K, B0, C0), self._pair_dtype),
+                            "nctx": np.empty((K, B0), np.uint8),
+                        }
+                        for j, b in enumerate(pending):
+                            arrays["centers"][j] = b["centers"]
+                            arrays["contexts"][j] = b["contexts"]
+                            arrays["nctx"][j] = b["nctx"]
                     else:
                         # one contiguous [K, 2, B] feed array (see _build_step notes),
                         # filled in place: nested np.stack + astype costs three copies
@@ -1122,12 +1142,15 @@ class Trainer:
                 "cannot be resumed exactly with shard_input=True — resume with "
                 "shard_input=False (or from an iteration-boundary checkpoint)")
 
+        C = 2 * cfg.window
+
         def local_stream():
-            """Local chunks: [K, 2, b_local] pairs + per-batch real counts and word
-            deltas. Pure numpy — safe on the producer thread (the allgather, a device
-            collective, must run on the main thread in identical order everywhere)."""
+            """Local chunks ([K, 2, b_local] pairs, or centers/contexts/nctx arrays
+            for CBOW) + per-batch real counts and word deltas. Pure numpy — safe on
+            the producer thread (the allgather, a device collective, must run on the
+            main thread in identical order everywhere)."""
             for k in range(start_iter, cfg.num_iterations + 1):
-                pending: List[np.ndarray] = []
+                pending: List[tuple] = []
                 reals: List[int] = []
                 deltas: List[int] = []
                 prev_ws = 0
@@ -1140,33 +1163,55 @@ class Trainer:
                     batches_in_iter += real
                     # filled in place, like the replicated flush: stacked copies
                     # throttle the producer
-                    pairs = np.zeros((K, 2, b_local), np.int32)
-                    for j, (c, x) in enumerate(pending):
-                        pairs[j, 0] = c
-                        pairs[j, 1] = x
+                    if cfg.cbow:
+                        arrays = {"centers": np.zeros((K, b_local), np.int32),
+                                  "contexts": np.zeros((K, b_local, C), np.int32),
+                                  "nctx": np.zeros((K, b_local), np.int32)}
+                        for j, (c, x, nc) in enumerate(pending):
+                            arrays["centers"][j] = c
+                            arrays["contexts"][j] = x
+                            arrays["nctx"][j] = nc
+                    else:
+                        pairs = np.zeros((K, 2, b_local), np.int32)
+                        for j, (c, x) in enumerate(pending):
+                            pairs[j, 0] = c
+                            pairs[j, 1] = x
+                        arrays = {"pairs": pairs}
                     while len(reals) < K:
                         reals.append(0)
                         deltas.append(0)
                     out = dict(
-                        pairs=pairs,
+                        arrays=arrays,
                         reals=np.asarray(reals, np.int32),
                         deltas=np.asarray(deltas, np.int64),
                         iteration=k, batches_done=batches_in_iter)
                     pending, reals, deltas = [], [], []
                     return out
 
-                for b in epoch_batches(
+                if cfg.cbow:
+                    stream = epoch_batches_cbow(
                         sentences, self.vocab, pairs_per_batch=b_local,
                         window=cfg.window, subsample_ratio=cfg.subsample_ratio,
                         seed=cfg.seed, iteration=k, shard=pid, num_shards=S,
-                        shuffle=cfg.shuffle):
+                        shuffle=cfg.shuffle)
+                else:
+                    stream = epoch_batches(
+                        sentences, self.vocab, pairs_per_batch=b_local,
+                        window=cfg.window, subsample_ratio=cfg.subsample_ratio,
+                        seed=cfg.seed, iteration=k, shard=pid, num_shards=S,
+                        shuffle=cfg.shuffle)
+                for b in stream:
                     ws = b.words_seen
                     if to_skip:  # exact resume: fast-forward already-trained batches
                         to_skip -= 1
                         prev_ws = ws
                         continue
-                    pending.append((b.centers, b.contexts))
-                    reals.append(b.num_real_pairs)
+                    if cfg.cbow:
+                        pending.append((b.centers, b.contexts, b.n_ctx))
+                        reals.append(b.num_real)
+                    else:
+                        pending.append((b.centers, b.contexts))
+                        reals.append(b.num_real_pairs)
                     deltas.append(ws - prev_ws)
                     prev_ws = ws
                     if len(pending) == K:
@@ -1183,7 +1228,12 @@ class Trainer:
         cur_iter, cur_batches = start_iter, skip
         exhausted = False
         self._start_run_bookkeeping()
-        zero_pairs = np.zeros((K, 2, b_local), np.int32)
+        if cfg.cbow:
+            zero_arrays = {"centers": np.zeros((K, b_local), np.int32),
+                           "contexts": np.zeros((K, b_local, C), np.int32),
+                           "nctx": np.zeros((K, b_local), np.int32)}
+        else:
+            zero_arrays = {"pairs": np.zeros((K, 2, b_local), np.int32)}
         try:
             while True:
                 t0 = time.perf_counter()
@@ -1191,7 +1241,7 @@ class Trainer:
                 self.host_wait_time += time.perf_counter() - t0
                 if local is None:
                     exhausted = True
-                    local = dict(pairs=zero_pairs,
+                    local = dict(arrays=zero_arrays,
                                  reals=np.zeros(K, np.int32),
                                  deltas=np.zeros(K, np.int64),
                                  iteration=cur_iter, batches_done=cur_batches)
@@ -1201,7 +1251,7 @@ class Trainer:
 
                 t0 = time.perf_counter()
                 g = multihost_utils.process_allgather({
-                    "pairs": local["pairs"],
+                    **local["arrays"],
                     "reals": local["reals"],
                     "deltas": local["deltas"],
                     "alive": np.asarray([0 if exhausted else 1], np.int32),
@@ -1210,9 +1260,24 @@ class Trainer:
                 if int(g["alive"].sum()) == 0:
                     break
                 reals_all = g["reals"]                              # [S, K]
-                # [S, K, 2, b] -> [K, 2, S, b] -> [K, 2, B]: segment s of every batch
-                # is process s's slice, matching the device-side segment masks
-                pairs_glob = np.transpose(g["pairs"], (1, 2, 0, 3)).reshape(K, 2, B)
+                # segment s of every batch is process s's slice, matching the
+                # device-side per-segment prefix masks
+                if cfg.cbow:
+                    feed = {
+                        # [S, K, b(, C)] -> [K, S, b(, C)] -> [K, B(, C)]
+                        "centers": np.transpose(g["centers"], (1, 0, 2)).reshape(
+                            K, B).astype(self._pair_dtype),
+                        "contexts": np.transpose(
+                            g["contexts"], (1, 0, 2, 3)).reshape(
+                                K, B, C).astype(self._pair_dtype),
+                        "nctx": np.transpose(g["nctx"], (1, 0, 2)).reshape(
+                            K, B).astype(np.uint8),
+                    }
+                else:
+                    # [S, K, 2, b] -> [K, 2, S, b] -> [K, 2, B]
+                    feed = {"pairs": np.transpose(
+                        g["pairs"], (1, 2, 0, 3)).reshape(K, 2, B).astype(
+                            self._pair_dtype)}
                 clocks = clock + np.cumsum(g["deltas"].sum(axis=0))
                 clock = float(clocks[-1])
                 alphas = np.asarray(
@@ -1225,9 +1290,7 @@ class Trainer:
                 real = int((reals_all > 0).any(axis=0).sum())
                 real_pairs = float(reals_all.sum())
 
-                stacked = put_global(
-                    self._chunk_shardings,
-                    {"pairs": pairs_glob.astype(self._pair_dtype)})
+                stacked = put_global(self._chunk_shardings, feed)
                 self.params, metrics = self._step_fn(
                     self.params, stacked, meta,
                     np.int32(self.global_step + 1),
@@ -1270,7 +1333,7 @@ class Trainer:
         if cfg.cbow:
             for b in epoch_batches_cbow(sentences, self.vocab, **common):
                 yield {"centers": b.centers, "contexts": b.contexts,
-                       "ctx_mask": b.ctx_mask, "real": b.num_real,
+                       "nctx": b.n_ctx, "real": b.num_real,
                        "words_seen": b.words_seen}
         else:
             for b in epoch_batches(sentences, self.vocab, **common):

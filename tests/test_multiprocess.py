@@ -49,7 +49,8 @@ vocab = build_vocab(sentences, min_count=1)
 cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
                      steps_per_dispatch=2, seed=7,
-                     shard_input=(mode in ("sharded", "resume")))
+                     cbow=(mode == "cbow"),
+                     shard_input=(mode in ("sharded", "resume", "cbow")))
 plan = make_mesh(2, 4)   # spans both processes: 8 global devices
 encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 
@@ -101,7 +102,7 @@ if mode == "resume":
 else:
     trainer = Trainer(cfg, vocab, plan=plan)
     assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
-    assert trainer._feed_segments == (2 if mode == "sharded" else 1)
+    assert trainer._feed_segments == (2 if mode in ("sharded", "cbow") else 1)
     trainer.fit(encoded)
     checksum = checksum_of(trainer)
     assert np.isfinite(checksum)
@@ -147,6 +148,13 @@ def test_two_process_training_sharded_feed(tmp_path):
     analog). Cross-process checksum agreement proves SPMD consistency of the
     assembled batches, alphas, and collective order."""
     _run_two(tmp_path, "sharded")
+
+
+@pytest.mark.slow
+def test_two_process_cbow_sharded_feed(tmp_path):
+    """CBOW on the sharded-input feed (round-4: the allgather protocol carries the
+    grouped centers/contexts/count arrays, not just packed pairs)."""
+    _run_two(tmp_path, "cbow")
 
 
 @pytest.mark.slow

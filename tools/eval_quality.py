@@ -171,6 +171,11 @@ def evaluate(model) -> dict:
          for w, ok in zip(words, is_topic_word)])
     topics = np.where(is_topic_word, topic_of(ranks_in_vocab), -1)
     content = np.where(topics >= 0)[0]
+    if content.size > 250_000:
+        # 1M-vocab runs: the [probes, content] similarity matrix would be ~8 GB;
+        # a fixed 250k-content sample keeps neighbor statistics intact
+        content = np.sort(np.random.default_rng(3).choice(
+            content, size=250_000, replace=False))
     # mid-frequency probes: skip the hottest 2k (near-uniform co-occurrence) and the
     # rarest tail (too few updates); small --vocab runs fall back to all content
     lo = min(2000, content.size // 4)
@@ -279,6 +284,8 @@ def main():
     ap.add_argument("--subsample", type=float, default=1e-4)
     ap.add_argument("--device-pairgen", action="store_true",
                     help="use the on-device pair generator feed")
+    ap.add_argument("--cbow", action="store_true",
+                    help="train the CBOW variant (BASELINE config 5)")
     ap.add_argument("--pool", type=int, default=512,
                     help="shared negative pool. Scale it with the batch: every pool "
                          "row absorbs all pairs' negative gradients x negatives/pool, "
@@ -311,7 +318,7 @@ def main():
         param_dtype=args.param_dtype,
         compute_dtype=args.param_dtype,
         logits_dtype=args.logits_dtype or "float32",
-        device_pairgen=args.device_pairgen)
+        device_pairgen=args.device_pairgen, cbow=args.cbow)
     t0 = time.perf_counter()
     model = est.fit(sents, encode_cache_dir=os.path.join(
         args.out, f"encoded_{args.words}_{args.vocab}_{args.min_count}"))
@@ -337,6 +344,7 @@ def main():
         "negative_pool": args.pool,
         "subsample_ratio": args.subsample,
         "device_pairgen": bool(args.device_pairgen),
+        "cbow": bool(args.cbow),
         "min_count": args.min_count,
     }
     if not args.corpus:
