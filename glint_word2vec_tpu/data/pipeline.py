@@ -230,13 +230,50 @@ def _block_pairs(
     ``center_word_index[p]`` is the kept-word ordinal (within this block) of pair p's
     center — the per-pair lr-decay clock, so downstream batches can credit exactly the
     words consumed *up to each batch* rather than the whole block at once."""
+    prologue = _subsample_and_window(
+        tokens, lengths, keep, window, seed, iteration, shard, token_base,
+        legacy_asymmetric_window)
+    if prologue is None:
+        return (np.empty(0, np.int32), np.empty(0, np.int32),
+                np.empty(0, np.int64), 0)
+    toks, left, total, Nk = prologue
+    num_pairs = int(total.sum())
+    if num_pairs == 0:
+        return (np.empty(0, np.int32), np.empty(0, np.int32),
+                np.empty(0, np.int64), int(Nk))
+    center_flat = np.repeat(np.arange(Nk, dtype=np.int64), total)
+    group_starts = np.cumsum(total) - total
+    offsets = np.arange(num_pairs, dtype=np.int64) - np.repeat(group_starts, total)
+    left_rep = np.repeat(left, total)
+    ctx_flat = center_flat - left_rep + offsets + (offsets >= left_rep)
+    return (toks[center_flat].astype(np.int32), toks[ctx_flat].astype(np.int32),
+            center_flat + 1, int(Nk))
+
+
+def _subsample_and_window(
+    tokens: np.ndarray,
+    lengths: np.ndarray,
+    keep: np.ndarray,
+    window: int,
+    seed: int,
+    iteration: int,
+    shard: int,
+    token_base: int,
+    legacy_asymmetric_window: bool,
+):
+    """Shared prologue of :func:`_block_pairs` and :func:`_block_cbow` — one place
+    owns the subsample/window stream contract (mirrored bit-identically by
+    native/pairgen.cpp and ops/pairgen.py).
+
+    Returns (kept_tokens, left, total, Nk) where ``left[i]``/``total[i]`` are pair
+    counts to the left / in total of kept position i under the per-position window
+    draw, or None for an empty block."""
     from glint_word2vec_tpu.data.hashrng import (
         STREAM_SUBSAMPLE, STREAM_WINDOW, hash_mod_at, hash_u01_at, stream_base)
 
     N = tokens.shape[0]
-    empty = (np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0, np.int64), 0)
     if N == 0:
-        return empty
+        return None
     ordinals = np.arange(token_base, token_base + N, dtype=np.uint64)
     sent_ids = np.repeat(np.arange(lengths.shape[0]), lengths)
     # subsample the whole block at once (mllib:371-379 semantics)
@@ -246,7 +283,7 @@ def _block_pairs(
     sids = sent_ids[kept_mask]
     Nk = toks.shape[0]
     if Nk == 0:
-        return empty
+        return None
     # per-sentence positions after subsampling
     new_lengths = np.bincount(sids, minlength=lengths.shape[0])
     new_starts = np.concatenate([[0], np.cumsum(new_lengths)])[:-1]
@@ -259,18 +296,8 @@ def _block_pairs(
     left = np.minimum(b, pos)
     right_extent = b if not legacy_asymmetric_window else b - 1
     right = np.clip(np.minimum(right_extent, slen - 1 - pos), 0, None)
-    total = left + right
-    num_pairs = int(total.sum())
-    if num_pairs == 0:
-        return (np.empty(0, np.int32), np.empty(0, np.int32),
-                np.empty(0, np.int64), int(Nk))
-    center_flat = np.repeat(np.arange(Nk, dtype=np.int64), total)
-    group_starts = np.cumsum(total) - total
-    offsets = np.arange(num_pairs, dtype=np.int64) - np.repeat(group_starts, total)
-    left_rep = np.repeat(left, total)
-    ctx_flat = center_flat - left_rep + offsets + (offsets >= left_rep)
-    return (toks[center_flat].astype(np.int32), toks[ctx_flat].astype(np.int32),
-            center_flat + 1, int(Nk))
+    total = (left + right).astype(np.int64)
+    return toks, left, total, int(Nk)
 
 
 def epoch_batches(
@@ -434,34 +461,15 @@ def _block_cbow(
     Returns (centers [Nk], contexts [Nk, 2*window] left-packed, n_ctx [Nk],
     center_word_index [Nk], words_kept). Positions with zero context are dropped
     (the per-sentence generator does the same)."""
-    from glint_word2vec_tpu.data.hashrng import (
-        STREAM_SUBSAMPLE, STREAM_WINDOW, hash_mod_at, hash_u01_at, stream_base)
-
     C = 2 * window
-    N = tokens.shape[0]
     empty = (np.empty(0, np.int32), np.empty((0, C), np.int32),
              np.empty(0, np.int32), np.empty(0, np.int64), 0)
-    if N == 0:
+    prologue = _subsample_and_window(
+        tokens, lengths, keep, window, seed, iteration, shard, token_base,
+        legacy_asymmetric_window)
+    if prologue is None:
         return empty
-    ordinals = np.arange(token_base, token_base + N, dtype=np.uint64)
-    sent_ids = np.repeat(np.arange(lengths.shape[0]), lengths)
-    sub_base = stream_base(seed, STREAM_SUBSAMPLE, iteration, shard)
-    kept_mask = hash_u01_at(sub_base, ordinals) <= keep.astype(np.float32)[tokens]
-    toks = tokens[kept_mask]
-    sids = sent_ids[kept_mask]
-    Nk = toks.shape[0]
-    if Nk == 0:
-        return empty
-    new_lengths = np.bincount(sids, minlength=lengths.shape[0])
-    new_starts = np.concatenate([[0], np.cumsum(new_lengths)])[:-1]
-    pos = np.arange(Nk, dtype=np.int64) - new_starts[sids]
-    slen = new_lengths[sids]
-    win_base = stream_base(seed, STREAM_WINDOW, iteration, shard)
-    b = hash_mod_at(win_base, ordinals[kept_mask], window)
-    left = np.minimum(b, pos)
-    right_extent = b if not legacy_asymmetric_window else b - 1
-    right = np.clip(np.minimum(right_extent, slen - 1 - pos), 0, None)
-    total = (left + right).astype(np.int64)
+    toks, left, total, Nk = prologue
     j = np.arange(C, dtype=np.int64)[None, :]
     ctx_pos = np.where(j < left[:, None],
                        np.arange(Nk, dtype=np.int64)[:, None] - left[:, None] + j,

@@ -1144,6 +1144,15 @@ class Trainer:
 
         C = 2 * cfg.window
 
+        def empty_feed() -> dict:
+            """One schema for the local per-chunk feed arrays — used zeroed for the
+            exhausted-process placeholder and as the fill target in flush()."""
+            if cfg.cbow:
+                return {"centers": np.zeros((K, b_local), np.int32),
+                        "contexts": np.zeros((K, b_local, C), np.int32),
+                        "nctx": np.zeros((K, b_local), np.int32)}
+            return {"pairs": np.zeros((K, 2, b_local), np.int32)}
+
         def local_stream():
             """Local chunks ([K, 2, b_local] pairs, or centers/contexts/nctx arrays
             for CBOW) + per-batch real counts and word deltas. Pure numpy — safe on
@@ -1163,20 +1172,16 @@ class Trainer:
                     batches_in_iter += real
                     # filled in place, like the replicated flush: stacked copies
                     # throttle the producer
+                    arrays = empty_feed()
                     if cfg.cbow:
-                        arrays = {"centers": np.zeros((K, b_local), np.int32),
-                                  "contexts": np.zeros((K, b_local, C), np.int32),
-                                  "nctx": np.zeros((K, b_local), np.int32)}
                         for j, (c, x, nc) in enumerate(pending):
                             arrays["centers"][j] = c
                             arrays["contexts"][j] = x
                             arrays["nctx"][j] = nc
                     else:
-                        pairs = np.zeros((K, 2, b_local), np.int32)
                         for j, (c, x) in enumerate(pending):
-                            pairs[j, 0] = c
-                            pairs[j, 1] = x
-                        arrays = {"pairs": pairs}
+                            arrays["pairs"][j, 0] = c
+                            arrays["pairs"][j, 1] = x
                     while len(reals) < K:
                         reals.append(0)
                         deltas.append(0)
@@ -1228,12 +1233,7 @@ class Trainer:
         cur_iter, cur_batches = start_iter, skip
         exhausted = False
         self._start_run_bookkeeping()
-        if cfg.cbow:
-            zero_arrays = {"centers": np.zeros((K, b_local), np.int32),
-                           "contexts": np.zeros((K, b_local, C), np.int32),
-                           "nctx": np.zeros((K, b_local), np.int32)}
-        else:
-            zero_arrays = {"pairs": np.zeros((K, 2, b_local), np.int32)}
+        zero_arrays = empty_feed()
         try:
             while True:
                 t0 = time.perf_counter()

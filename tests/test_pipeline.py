@@ -186,3 +186,35 @@ def test_words_seen_advances_per_batch_not_per_block():
     assert ws[0] < total / 2                 # first batch is NOT credited the whole corpus
     assert ws[-1] <= total
     assert ws[-1] >= total - 40              # last center is near the corpus end
+
+
+def test_block_cbow_matches_block_pairs_grouping():
+    """Property: grouping _block_pairs' flat (center, context) stream by center
+    ordinal must reproduce _block_cbow's left-packed rows exactly — the two
+    generators share one prologue (_subsample_and_window), and this pins the
+    expansion halves to each other (boundary clipping, packing order, clock)."""
+    from glint_word2vec_tpu.data.pipeline import (
+        _block_cbow, _block_pairs, keep_probabilities)
+
+    rng = np.random.default_rng(5)
+    V, W = 300, 4
+    lengths = rng.integers(1, 25, 80).astype(np.int64)
+    tokens = rng.integers(0, V, int(lengths.sum())).astype(np.int32)
+    counts = np.maximum(1000 / (np.arange(V) + 2.0), 1.0)
+    keep = keep_probabilities(counts, int(counts.sum()), 1e-2)
+    args = (tokens, lengths, keep, W, 9, 2, 1, 12345, True)
+    pc, px, pclock, pkept = _block_pairs(*args)
+    cc, cx, cn, cclock, ckept = _block_cbow(*args)
+    assert pkept == ckept
+    # group the flat pairs by center ordinal (pclock is center ordinal + 1)
+    assert np.array_equal(np.unique(pclock), np.sort(cclock))
+    total = 0
+    for row in range(cc.shape[0]):
+        sel = pclock == cclock[row]
+        n = int(sel.sum())
+        assert n == cn[row]
+        assert np.all(pc[sel] == cc[row])                 # same center token
+        np.testing.assert_array_equal(px[sel], cx[row, :n])  # same packed contexts
+        assert np.all(cx[row, n:] == 0)                   # masked slots zeroed
+        total += n
+    assert total == pc.shape[0]

@@ -157,12 +157,15 @@ def generate_corpus(path: str, n_words: int, seed: int, v_raw: int = V_RAW) -> N
         f"written in {time.perf_counter() - t0:.1f}s -> {path}")
 
 
-def evaluate(model) -> dict:
-    """Topic purity@10 + cosine margin over 2,000 mid-frequency probe words,
-    with a random-embedding baseline for scale."""
+def evaluate(words, emb: np.ndarray, index=None) -> dict:
+    """Topic purity@10 + cosine margin over 2,000 mid-frequency probe words, with a
+    random-embedding baseline for scale. All big reductions (similarities, top-k,
+    masked means) run ON DEVICE and only tiny results come back — fetching a
+    [probes, content] matrix over the remote tunnel takes tens of minutes at 1M
+    vocab (measured the hard way)."""
+    import jax
     import jax.numpy as jnp
 
-    words = model.vocab.words
     # entity/role types (ea_/eb_/ra_/rb_) carry no topic; exclude from purity
     is_topic_word = np.asarray(
         [w.startswith(("t", "s_")) and "_w" in w for w in words])
@@ -172,7 +175,6 @@ def evaluate(model) -> dict:
     topics = np.where(is_topic_word, topic_of(ranks_in_vocab), -1)
     content = np.where(topics >= 0)[0]
     if content.size > 250_000:
-        # 1M-vocab runs: the [probes, content] similarity matrix would be ~8 GB;
         # a fixed 250k-content sample keeps neighbor statistics intact
         content = np.sort(np.random.default_rng(3).choice(
             content, size=250_000, replace=False))
@@ -185,33 +187,43 @@ def evaluate(model) -> dict:
         probe_pool = content
     rng = np.random.default_rng(0)
     probes = rng.choice(probe_pool, size=min(2000, probe_pool.size), replace=False)
+    # probe position within content (probes are drawn from content)
+    self_pos = np.searchsorted(content, probes)
+    topics_probes = jnp.asarray(topics[probes])
+    topics_content = jnp.asarray(topics[content])
 
-    def purity(emb):
-        e = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
-        q = jnp.asarray(e[probes])
-        base = jnp.asarray(e[content])
-        sims = np.array(q @ base.T)                         # [P, C] (writable copy)
-        # mask self
-        self_pos = {int(c): i for i, c in enumerate(content)}
-        for i, pr in enumerate(probes):
-            sims[i, self_pos[int(pr)]] = -np.inf
-        top = np.argpartition(-sims, 10, axis=1)[:, :10]
-        neigh_topics = topics[content[top]]                 # [P, 10]
-        pur = float((neigh_topics == topics[probes][:, None]).mean())
-        # cosine margin on a subsample
+    @jax.jit
+    def device_purity(q, base, self_idx, t_probes, t_content):
+        qn = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        bn = base / jnp.maximum(jnp.linalg.norm(base, axis=1, keepdims=True), 1e-12)
+        sims = qn @ bn.T                                    # [P, C] — stays on device
+        rows = jnp.arange(sims.shape[0])
+        sims = sims.at[rows, self_idx].set(-jnp.inf)
+        _, top = jax.lax.top_k(sims, 10)                    # [P, 10]
+        neigh = t_content[top]
+        pur = (neigh == t_probes[:, None]).mean()
         sub = sims[:, :4000]
-        same = topics[content[:4000]][None, :] == topics[probes][:, None]
-        finite = np.isfinite(sub)
-        within = float(sub[same & finite].mean())
-        cross = float(sub[~same & finite].mean())
+        same = t_content[None, :4000] == t_probes[:, None]
+        finite = jnp.isfinite(sub)
+        sub0 = jnp.where(finite, sub, 0.0)
+        within = (sub0 * (same & finite)).sum() / jnp.maximum(
+            (same & finite).sum(), 1)
+        cross = (sub0 * (~same & finite)).sum() / jnp.maximum(
+            (~same & finite).sum(), 1)
         return pur, within - cross
 
-    emb = np.asarray(model.syn0, np.float32)
+    def purity(e):
+        pur, margin = device_purity(
+            jnp.asarray(e[probes]), jnp.asarray(e[content]),
+            jnp.asarray(self_pos), topics_probes, topics_content)
+        return float(pur), float(margin)
+
     if np.isnan(emb).any():
         return {"diverged": True,
                 "nan_rows": int(np.isnan(emb).any(axis=1).sum())}
     pur, margin = purity(emb)
-    rnd = np.random.default_rng(1).normal(size=emb.shape).astype(np.float32)
+    rnd = np.random.default_rng(1).standard_normal(
+        emb.shape, dtype=np.float32)
     pur0, margin0 = purity(rnd)
     out = {
         "purity_at_10": round(pur, 4),
@@ -221,18 +233,23 @@ def evaluate(model) -> dict:
         "probes": int(probes.size),
         "topics": T_TOPICS,
     }
-    out.update(evaluate_analogies(model, emb))
+    if index is None:
+        index = {w: i for i, w in enumerate(words)}
+    out.update(evaluate_analogies(index, emb))
     return out
 
 
-def evaluate_analogies(model, emb: np.ndarray) -> dict:
+def evaluate_analogies(index, emb: np.ndarray) -> dict:
     """The reference's analogy gate (wien − österreich + deutschland ≈ berlin,
     it spec:327-352) run quantitatively over the generator's entity pairs:
     for ordered pairs (i, j), query v = b_i − a_i + a_j and check that the
     cosine-nearest word over the FULL vocabulary (query words excluded, like the
     reference's findSynonyms excludes the query) is b_j. Reports accuracy@1 and
-    the mean cosine to the correct answer (the gate's >0.9 analog)."""
-    index = model.vocab.index
+    the mean cosine to the correct answer (the gate's >0.9 analog). Device-side:
+    at 1M vocab the [queries, V] similarity matrix must not cross to the host."""
+    import jax
+    import jax.numpy as jnp
+
     ea, eb, _, _ = relation_names()
     ia = np.asarray([index.get(w, -1) for w in ea])
     ib = np.asarray([index.get(w, -1) for w in eb])
@@ -241,25 +258,34 @@ def evaluate_analogies(model, emb: np.ndarray) -> dict:
     n = ia.size
     if n < 4:
         return {"analogy_pairs_in_vocab": int(n)}
-    e = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
     rng = np.random.default_rng(7)
     n_q = min(512, n * (n - 1))
     qi = rng.integers(0, n, n_q)
     qj = rng.integers(0, n - 1, n_q)
     qj = np.where(qj >= qi, qj + 1, qj)       # j != i
-    v = e[ib[qi]] - e[ia[qi]] + e[ia[qj]]
-    v /= np.maximum(np.linalg.norm(v, axis=1, keepdims=True), 1e-12)
-    sims = v @ e.T                            # [n_q, V]
-    cos_correct = sims[np.arange(n_q), ib[qj]].copy()
-    sims[np.arange(n_q), ia[qi]] = -np.inf    # exclude the query words
-    sims[np.arange(n_q), ib[qi]] = -np.inf
-    sims[np.arange(n_q), ia[qj]] = -np.inf
-    top1 = sims.argmax(axis=1)
+
+    @jax.jit
+    def device_analogy(e, a_i, b_i, a_j, b_j):
+        en = e / jnp.maximum(jnp.linalg.norm(e, axis=1, keepdims=True), 1e-12)
+        v = en[b_i] - en[a_i] + en[a_j]
+        v = v / jnp.maximum(jnp.linalg.norm(v, axis=1, keepdims=True), 1e-12)
+        sims = v @ en.T                       # [n_q, V] — stays on device
+        rows = jnp.arange(sims.shape[0])
+        cos_correct = sims[rows, b_j]
+        sims = sims.at[rows, a_i].set(-jnp.inf)
+        sims = sims.at[rows, b_i].set(-jnp.inf)
+        sims = sims.at[rows, a_j].set(-jnp.inf)
+        top1 = sims.argmax(axis=1)
+        return (top1 == b_j).mean(), cos_correct.mean()
+
+    acc, cos_mean = device_analogy(
+        jnp.asarray(emb), jnp.asarray(ia[qi]), jnp.asarray(ib[qi]),
+        jnp.asarray(ia[qj]), jnp.asarray(ib[qj]))
     return {
         "analogy_pairs_in_vocab": int(n),
         "analogy_queries": int(n_q),
-        "analogy_accuracy_at_1": round(float((top1 == ib[qj]).mean()), 4),
-        "analogy_mean_cosine_to_answer": round(float(cos_correct.mean()), 4),
+        "analogy_accuracy_at_1": round(float(acc), 4),
+        "analogy_mean_cosine_to_answer": round(float(cos_mean), 4),
     }
 
 
@@ -286,6 +312,10 @@ def main():
                     help="use the on-device pair generator feed")
     ap.add_argument("--cbow", action="store_true",
                     help="train the CBOW variant (BASELINE config 5)")
+    ap.add_argument("--rescore", action="store_true",
+                    help="skip training: score the syn0.npy + vocab_words.txt "
+                         "already saved under --out (e.g. after an interrupted "
+                         "metrics pass)")
     ap.add_argument("--pool", type=int, default=512,
                     help="shared negative pool. Scale it with the batch: every pool "
                          "row absorbs all pairs' negative gradients x negatives/pool, "
@@ -299,6 +329,30 @@ def main():
     from glint_word2vec_tpu.models.estimator import Word2Vec
 
     os.makedirs(args.out, exist_ok=True)
+    if args.rescore:
+        emb = np.load(os.path.join(args.out, "syn0.npy"))
+        with open(os.path.join(args.out, "vocab_words.txt")) as f:
+            words = f.read().splitlines()
+        if not any(w.startswith(("t0", "t1", "s_")) and "_w" in w
+                   for w in words[:1000]):
+            ap.error("--rescore needs a model trained on the synthetic ground-truth "
+                     "corpus (vocab_words.txt has no t###_w##### names); external-"
+                     "corpus models have no labels to score against")
+        result = {"metric": "topic_recovery_at_text8_scale", "rescored": True,
+                  "corpus_words": args.words, "vocab_raw": args.vocab,
+                  "vocab_size": len(words), "dim": int(emb.shape[1]),
+                  "iterations": args.iters, "param_dtype": args.param_dtype,
+                  "logits_dtype": args.logits_dtype or "float32",
+                  "pairs_per_batch": args.batch, "negative_pool": args.pool,
+                  "subsample_ratio": args.subsample,
+                  "device_pairgen": bool(args.device_pairgen),
+                  "cbow": bool(args.cbow), "min_count": args.min_count}
+        result.update(evaluate(words, emb.astype(np.float32)))
+        print(json.dumps(result))
+        with open(os.path.join(os.path.dirname(_here), "EVAL_RUNS.jsonl"),
+                  "a") as f:
+            f.write(json.dumps(result) + "\n")
+        return
     if args.corpus:
         corpus_path = args.corpus
     else:
@@ -348,7 +402,9 @@ def main():
         "min_count": args.min_count,
     }
     if not args.corpus:
-        result.update(evaluate(model))
+        result.update(evaluate(model.vocab.words,
+                               np.asarray(model.syn0, np.float32),
+                               model.vocab.index))
         # machine-readable run log: bench.py's headline cross-check refuses configs
         # this file marks divergent or has never seen. Only ground-truth (synthetic
         # corpus) runs qualify as stability evidence — external-corpus runs have no
