@@ -97,9 +97,12 @@ def load_eval_stability(repo_root: str) -> list:
 
 def eval_stable(rows: list, batch: int, pool: int, param_dtype: str) -> bool:
     """True iff tools/eval_quality.py trained this geometry on >=60M words without
-    divergence. The bench REFUSES to headline configs without this evidence."""
+    divergence. The bench REFUSES to headline configs without this evidence.
+    Rescored rows don't count: their config metadata comes from CLI flags,
+    unverified against the saved model they re-scored."""
     for r in rows:
-        if (r.get("pairs_per_batch") == batch
+        if (not r.get("rescored")
+                and r.get("pairs_per_batch") == batch
                 and r.get("negative_pool") == pool
                 and r.get("param_dtype") == param_dtype
                 and r.get("corpus_words", 0) >= 60_000_000

@@ -99,7 +99,10 @@ class Word2VecConfig:
                                     # 128 keeping the pool-row load pairs_per_batch *
                                     # negatives / pool <= 600 — the measured 60M-word
                                     # stability rule (EVAL.md; a fixed small pool under a
-                                    # large batch provably diverges, e.g. B=64k/P=64)
+                                    # large batch provably diverges, e.g. B=64k/P=64) —
+                                    # except batches < 4096 pairs, which resolve to 0:
+                                    # per-pair is fast enough there and shared negatives
+                                    # cost quality on small corpora (toy bf16 gate)
     pad_vector_to_lanes: bool = True  # pad the embedding minor dim to a multiple of 128
                                       # (TPU lane width) — D=300 rows are misaligned and
                                       # measurably slower than padded 384; exports are
@@ -220,11 +223,22 @@ class Word2VecConfig:
         # changes (a resolved auto pool must not stick to a new pairs_per_batch)
         self._auto_pool = self.negative_pool == -1
         if self.negative_pool == -1:
-            # AUTO: scale the shared pool with the batch so the per-row load stays
-            # inside the measured 60M-word stability boundary (load <= 600, EVAL.md),
-            # rounded up to the 128-lane MXU tile
-            p_min = -(-self.pairs_per_batch * self.negatives // 600)
-            self.negative_pool = max(128, 128 * (-(-p_min // 128)))
+            if self.pairs_per_batch < 4096 and not self.use_pallas:
+                # Small batches take the per-pair exact path (the reference's G3
+                # semantics): the shared pool's matmul amortization buys nothing at
+                # this scale, and shared negatives measurably cost quality on small
+                # corpora (the bf16 toy-corpus gate fails at B=256/P=128 but passes
+                # per-pair — tests/test_integration_toy.py). The pallas step needs a
+                # pool, so use_pallas keeps the load-rule resolution below. NB the
+                # per-pair path always runs its logit chain in f32 (trainer.py);
+                # logits_dtype applies to the shared-pool paths.
+                self.negative_pool = 0
+            else:
+                # AUTO: scale the shared pool with the batch so the per-row load
+                # stays inside the measured 60M-word stability boundary
+                # (load <= 600, EVAL.md), rounded up to the 128-lane MXU tile
+                p_min = -(-self.pairs_per_batch * self.negatives // 600)
+                self.negative_pool = max(128, 128 * (-(-p_min // 128)))
         if self.negative_pool < 0:
             raise ValueError(
                 f"negative_pool must be nonnegative (or -1 for auto) "
