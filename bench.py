@@ -242,6 +242,29 @@ def bench_cbow_step(counts, b: int, pool: int, param_dtype: str = "bfloat16",
     return eps, 0.0
 
 
+_E2E_CORPUS = None
+
+
+def e2e_corpus():
+    """The shared e2e corpus (vocab + encoded sentences) — built once; both feed
+    modes and every trial reuse it (building it twice cost ~1 min of bench wall)."""
+    global _E2E_CORPUS
+    if _E2E_CORPUS is None:
+        from glint_word2vec_tpu.data.pipeline import encode_sentences
+        from glint_word2vec_tpu.data.vocab import build_vocab
+        rng = np.random.default_rng(0)
+        n_words, sent_len, vocab_sz = 4_000_000, 40, 50_000
+        zipf = 1.0 / (np.arange(vocab_sz) + 10.0) ** 1.05
+        ids = rng.choice(vocab_sz, size=n_words, p=zipf / zipf.sum())
+        words = np.char.add("w", ids.astype("U8"))
+        sentences = [list(words[i:i + sent_len])
+                     for i in range(0, n_words, sent_len)]
+        vocab = build_vocab(sentences, min_count=5)
+        encoded = encode_sentences(sentences, vocab, 1000)
+        _E2E_CORPUS = (vocab, encoded)
+    return _E2E_CORPUS
+
+
 def bench_e2e(device_pairgen: bool, param_dtype: str, logits_dtype: str,
               pool: int) -> tuple:
     """End-to-end Word2Vec-style fit on a synthetic Zipf corpus — includes vocab
@@ -250,25 +273,15 @@ def bench_e2e(device_pairgen: bool, param_dtype: str, logits_dtype: str,
     import jax.numpy as jnp
 
     from glint_word2vec_tpu.config import Word2VecConfig
-    from glint_word2vec_tpu.data.pipeline import encode_sentences
-    from glint_word2vec_tpu.data.vocab import build_vocab
     from glint_word2vec_tpu.train.trainer import Trainer
 
-    rng = np.random.default_rng(0)
-    n_words, sent_len, vocab_sz = 4_000_000, 40, 50_000
-    zipf = 1.0 / (np.arange(vocab_sz) + 10.0) ** 1.05
-    ids = rng.choice(vocab_sz, size=n_words, p=zipf / zipf.sum())
-    words = np.char.add("w", ids.astype("U8"))
-    sentences = [list(words[i:i + sent_len])
-                 for i in range(0, n_words, sent_len)]
-    vocab = build_vocab(sentences, min_count=5)
+    vocab, encoded = e2e_corpus()
     cfg = Word2VecConfig(
         vector_size=D, min_count=5, pairs_per_batch=B_MAIN, num_iterations=1,
         window=5, negatives=NEG, negative_pool=pool, steps_per_dispatch=E2E_K,
         seed=1, subsample_ratio=1e-4, device_pairgen=device_pairgen,
         param_dtype=param_dtype, compute_dtype=param_dtype,
         logits_dtype=logits_dtype)
-    encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
     trainer = Trainer(cfg, vocab)
     trainer.fit(encoded[:400])  # warm the jit cache
     rates, hw = [], []
@@ -321,8 +334,10 @@ def bench_scale_1m() -> dict:
     from glint_word2vec_tpu.models.word2vec import Word2VecModel
     words = np.char.add("w", np.arange(V_SCALE).astype("U8"))
     vocab = Vocabulary.from_words_and_counts(list(words), counts.astype(np.int64))
-    rng = np.random.default_rng(1)
-    syn0 = rng.standard_normal((V_SCALE, D), np.float32) * 0.1
+    # create the 1.2 GB test embedding ON device — a host array here would ride
+    # the (slow) host->device link and time the wire, not the model op
+    syn0 = jax.random.normal(jax.random.key(1), (V_SCALE, D), jnp.float32) * 0.1
+    syn0.block_until_ready()
     model = Word2VecModel(vocab, syn0, syn1=None,
                           config=Word2VecConfig(vector_size=D))
     model.find_synonyms("w0", 10)  # compile + warm

@@ -167,9 +167,14 @@ class Word2VecConfig:
                                     # token budget — statistically identical; contract
                                     # + tests in ops/pairgen.py). Use when the
                                     # host→device feed link is the bottleneck (thin
-                                    # PCIe/DCN/tunnel links). Skip-gram single-process
-                                    # only (CBOW and the multi-process allgather feed
-                                    # stay on host generation)
+                                    # PCIe/DCN/tunnel links). Skip-gram only (CBOW
+                                    # batches are grouped windows the device generator
+                                    # does not produce). Multi-process: combine with
+                                    # shard_input=True — each process packs token
+                                    # blocks for its own data segments and the
+                                    # iteration-barrier allgather keeps training
+                                    # bit-identical to single-process
+                                    # (trainer._fit_device_feed_sharded)
     tokens_per_step: int = 0        # device_pairgen: raw token slots per step; 0 sizes
                                     # automatically from pairs_per_batch, window, and the
                                     # subsample keep ratio (targeting ~93% pair-slot fill;

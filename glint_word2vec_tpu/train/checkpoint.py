@@ -87,6 +87,12 @@ class TrainState:
     global_step: int = 0
     batches_done: int = 0
     shard_progress: Optional[List[List[int]]] = None
+    # which stream shard_progress positions index: "pairs" (_fit_sharded's
+    # per-process pair-batch streams) or "tokens" (_fit_device_feed_sharded's
+    # token-step rows). The two count different things, so resuming one with the
+    # other would silently mis-position; None on single-process checkpoints and
+    # on pre-round-4 sharded ones (accepted as "pairs", the only kind then)
+    shard_feed: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -95,7 +101,8 @@ class TrainState:
     def from_dict(cls, d: Dict[str, Any]) -> "TrainState":
         return cls(**{k: d[k]
                       for k in ("iteration", "words_processed", "finished",
-                                "global_step", "batches_done", "shard_progress")
+                                "global_step", "batches_done", "shard_progress",
+                                "shard_feed")
                       if k in d})
 
 

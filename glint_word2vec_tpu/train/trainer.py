@@ -246,9 +246,18 @@ class Trainer:
                                  "are grouped windows the device generator does "
                                  "not produce)")
             if jax.process_count() > 1:
-                raise ValueError(
-                    "device_pairgen does not support multi-process runs yet — "
-                    "use the host feed (shard_input allgather protocol) there")
+                if not config.shard_input:
+                    raise ValueError(
+                        "device_pairgen with multiple processes requires "
+                        "shard_input=True (each process packs token blocks for "
+                        "its own data segments; a replicated token feed would "
+                        "have every process regenerate everything)")
+                if self.plan.num_data % jax.process_count():
+                    raise ValueError(
+                        f"device_pairgen across {jax.process_count()} processes "
+                        f"needs the mesh data degree ({self.plan.num_data}) "
+                        "divisible by the process count — each process produces "
+                        "num_data/process_count token segments")
             if config.use_pallas:
                 raise ValueError("device_pairgen is not supported with use_pallas")
             S = self.plan.num_data
@@ -581,6 +590,10 @@ class Trainer:
             self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
         total_words = float(cfg.num_iterations * train_words + 1)
         K = max(1, cfg.steps_per_dispatch)
+        if self._feed_segments > 1 and cfg.device_pairgen:
+            return self._fit_device_feed_sharded(
+                sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
+                total_words, float(train_words), K)
         if self._feed_segments > 1:
             return self._fit_sharded(
                 sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
@@ -729,6 +742,77 @@ class Trainer:
             self.save_checkpoint(checkpoint_path)
         return self.params
 
+    def _device_seg_blocks(self, sentences: Sequence[np.ndarray], k: int, s: int):
+        """[T]-token blocks of data segment s, iteration k, for the device pair
+        generator — SUBSAMPLED on the host (same hashrng draws on raw ordinals as
+        data/pipeline, vectorized over ~1M-raw-token slabs; a per-sentence Python
+        loop measurably starved the feed), so the wire carries only kept tokens and
+        the lr clock is exact. The kept stream is cut at T boundaries — a sentence
+        straddling a cut loses its cross-cut window context, the same class of
+        boundary as the reference's maxSentenceLength chunking (mllib:341); at
+        production T (tens of thousands) that is ~0.02% of windows. Yields
+        (tokens[T], start_bits, n_valid, kept_ordinal_base, kept_count).
+
+        Deterministic per (seed, k, s) and independent of which process runs it —
+        the property the sharded multi-process feed relies on (a 2-process run's
+        segment s is bit-identical to a single-process run's)."""
+        from glint_word2vec_tpu.data.hashrng import (
+            STREAM_SUBSAMPLE, hash_u01_at, stream_base)
+        from glint_word2vec_tpu.data.pipeline import (
+            iter_sentence_slabs, stream_rng)
+        cfg = self.config
+        Sd = self.plan.num_data
+        T = self._tokens_per_step
+        tok_dt = self._pair_dtype
+        keep = self._keep_host
+        rng = stream_rng(cfg.seed, k, s)
+        order = np.arange(s, len(sentences), Sd)
+        if cfg.shuffle:
+            rng.shuffle(order)
+        sub_base = stream_base(cfg.seed, STREAM_SUBSAMPLE, k, s)
+        base, raw_ord = 0, 0
+        rest_tok = np.empty(0, tok_dt)
+        rest_start = np.empty(0, bool)
+
+        def emit(toks, starts):
+            n = toks.shape[0]
+            buf = np.zeros(T, tok_dt)
+            buf[:n] = toks
+            bits = np.packbits(np.pad(starts, (0, T - n)), bitorder="little")
+            return (buf, bits, n, base, float(n))
+
+        for slab in iter_sentence_slabs(sentences, order):
+            tokens = np.concatenate(slab) if len(slab) > 1 else slab[0]
+            lens = np.fromiter((x.shape[0] for x in slab), np.int64, len(slab))
+            n = tokens.shape[0]
+            sids = np.repeat(np.arange(len(slab)), lens)
+            if cfg.subsample_ratio > 0:
+                u = hash_u01_at(sub_base, np.arange(
+                    raw_ord, raw_ord + n, dtype=np.uint64))
+                m = u <= keep[tokens]
+                ktoks, ksids = tokens[m], sids[m]
+            else:
+                ktoks, ksids = tokens, sids
+            raw_ord += n
+            if ktoks.shape[0] == 0:
+                continue
+            kstart = np.empty(ktoks.shape[0], bool)
+            kstart[0] = True
+            kstart[1:] = ksids[1:] != ksids[:-1]
+            rest_tok = np.concatenate([rest_tok, ktoks.astype(tok_dt)])
+            rest_start = np.concatenate([rest_start, kstart])
+            while rest_tok.shape[0] >= T:
+                yield emit(rest_tok[:T], rest_start[:T])
+                base += T
+                rest_tok = rest_tok[T:]
+                rest_start = rest_start[T:].copy()
+                if rest_start.shape[0]:
+                    # the cut tail acts as a new sentence (device treats the
+                    # leading run of a block as one regardless)
+                    rest_start[0] = True
+        if rest_tok.shape[0]:
+            yield emit(rest_tok, rest_start)
+
     def _fit_device_feed(
         self,
         sentences: Sequence[np.ndarray],
@@ -754,12 +838,9 @@ class Trainer:
         cfg = self.config
         from glint_word2vec_tpu.data.hashrng import (
             STREAM_SUBSAMPLE, STREAM_WINDOW, stream_base)
-        from glint_word2vec_tpu.data.pipeline import stream_rng
         Sd = self.plan.num_data
         T = self._tokens_per_step
         tok_dt = self._pair_dtype
-        keep = self._keep_host
-        B = cfg.pairs_per_batch
         if self.state.shard_progress is not None and not self.state.finished:
             raise ValueError(
                 "checkpoint was written by a sharded-input multi-process run; "
@@ -771,65 +852,7 @@ class Trainer:
         b = np.arange(cfg.window, dtype=np.float64)
         rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()
 
-        def seg_blocks(k: int, s: int):
-            """[T]-token blocks of segment s, iteration k — SUBSAMPLED on the host
-            (same hashrng draws on raw ordinals as data/pipeline, vectorized over
-            ~1M-raw-token slabs; a per-sentence Python loop measurably starved the
-            feed), so the wire carries only kept tokens and the lr clock is exact.
-            The kept stream is cut at T boundaries — a sentence straddling a cut
-            loses its cross-cut window context, the same class of boundary as the
-            reference's maxSentenceLength chunking (mllib:341); at production T
-            (tens of thousands) that is ~0.02% of windows. Yields
-            (tokens[T], start_bits, n_valid, kept_ordinal_base, kept_count)."""
-            from glint_word2vec_tpu.data.hashrng import hash_u01_at
-            rng = stream_rng(cfg.seed, k, s)
-            order = np.arange(s, len(sentences), Sd)
-            if cfg.shuffle:
-                rng.shuffle(order)
-            sub_base = stream_base(cfg.seed, STREAM_SUBSAMPLE, k, s)
-            base, raw_ord = 0, 0
-            rest_tok = np.empty(0, tok_dt)
-            rest_start = np.empty(0, bool)
-
-            def emit(toks, starts):
-                n = toks.shape[0]
-                buf = np.zeros(T, tok_dt)
-                buf[:n] = toks
-                bits = np.packbits(np.pad(starts, (0, T - n)), bitorder="little")
-                return (buf, bits, n, base, float(n))
-
-            from glint_word2vec_tpu.data.pipeline import iter_sentence_slabs
-            for slab in iter_sentence_slabs(sentences, order):
-                tokens = np.concatenate(slab) if len(slab) > 1 else slab[0]
-                lens = np.fromiter((x.shape[0] for x in slab), np.int64, len(slab))
-                n = tokens.shape[0]
-                sids = np.repeat(np.arange(len(slab)), lens)
-                if cfg.subsample_ratio > 0:
-                    u = hash_u01_at(sub_base, np.arange(
-                        raw_ord, raw_ord + n, dtype=np.uint64))
-                    m = u <= keep[tokens]
-                    ktoks, ksids = tokens[m], sids[m]
-                else:
-                    ktoks, ksids = tokens, sids
-                raw_ord += n
-                if ktoks.shape[0] == 0:
-                    continue
-                kstart = np.empty(ktoks.shape[0], bool)
-                kstart[0] = True
-                kstart[1:] = ksids[1:] != ksids[:-1]
-                rest_tok = np.concatenate([rest_tok, ktoks.astype(tok_dt)])
-                rest_start = np.concatenate([rest_start, kstart])
-                while rest_tok.shape[0] >= T:
-                    yield emit(rest_tok[:T], rest_start[:T])
-                    base += T
-                    rest_tok = rest_tok[T:]
-                    rest_start = rest_start[T:].copy()
-                    if rest_start.shape[0]:
-                        # the cut tail acts as a new sentence (device treats the
-                        # leading run of a block as one regardless)
-                        rest_start[0] = True
-            if rest_tok.shape[0]:
-                yield emit(rest_tok, rest_start)
+        seg_blocks = lambda k, s: self._device_seg_blocks(sentences, k, s)
 
         def chunk_stream():
             for k in range(start_iter, cfg.num_iterations + 1):
@@ -911,7 +934,9 @@ class Trainer:
                 if pending:
                     yield flush()
 
-        staged = cfg.prefetch_chunks > 0  # device_pairgen is single-process only
+        staged = cfg.prefetch_chunks > 0  # this method is the single-process path
+                                          # (multi-process device feed goes through
+                                          # _fit_device_feed_sharded)
         if staged:
             chunks = _threaded_iter(
                 self._stage_to_device(chunk_stream()), cfg.prefetch_chunks)
@@ -955,24 +980,330 @@ class Trainer:
             if closer is not None:
                 closer()
 
-        if pairs_arrays:
-            exact = float(jnp.concatenate(pairs_arrays).sum())
-            dropped_total = float(jnp.stack(dropped_arrays).sum())
-            # heartbeats ran on the analytic estimate; settle the books exactly
-            self.pairs_trained += exact - est_total
-            self._pairs_since_log = max(
-                self._pairs_since_log + exact - est_total, 0.0)
-            if dropped_total > 0.02 * max(exact, 1.0):
-                logger.warning(
-                    "device pairgen dropped %.0f pairs (%.1f%% of %.0f trained) to "
-                    "overflow — raise tokens_per_step (or lower pairs_per_batch "
-                    "fill pressure)", dropped_total,
-                    100.0 * dropped_total / exact, exact)
-            elif dropped_total:
-                logger.info("device pairgen: %.0f overflow pairs dropped "
-                            "(%.3f%%)", dropped_total,
-                            100.0 * dropped_total / max(exact, 1.0))
+        self._settle_device_pairgen_books(pairs_arrays, dropped_arrays, est_total)
+        self.state = TrainState(
+            iteration=cfg.num_iterations,
+            words_processed=int(cfg.num_iterations * train_words),
+            finished=True, global_step=self.global_step)
+        if checkpoint_path:
+            self.save_checkpoint(checkpoint_path)
+        return self.params
 
+    def _settle_device_pairgen_books(
+        self,
+        pairs_arrays: List[jax.Array],
+        dropped_arrays: List[jax.Array],
+        est_total: float,
+    ) -> None:
+        """End-of-run accounting shared by both device-feed paths: heartbeats ran
+        on the analytic pair estimate; settle the books against the exact trained
+        and overflow-dropped totals the device reports."""
+        if not pairs_arrays:
+            return
+        exact = float(jnp.concatenate(pairs_arrays).sum())
+        dropped_total = float(jnp.stack(dropped_arrays).sum())
+        self.pairs_trained += exact - est_total
+        self._pairs_since_log = max(
+            self._pairs_since_log + exact - est_total, 0.0)
+        if dropped_total > 0.02 * max(exact, 1.0):
+            logger.warning(
+                "device pairgen dropped %.0f pairs (%.1f%% of %.0f trained) to "
+                "overflow — raise tokens_per_step (or lower pairs_per_batch "
+                "fill pressure)", dropped_total,
+                100.0 * dropped_total / exact, exact)
+        elif dropped_total:
+            logger.info("device pairgen: %.0f overflow pairs dropped "
+                        "(%.3f%%)", dropped_total,
+                        100.0 * dropped_total / max(exact, 1.0))
+
+    def _fit_device_feed_sharded(
+        self,
+        sentences: Sequence[np.ndarray],
+        checkpoint_path: Optional[str],
+        checkpoint_every_steps: Optional[int],
+        on_heartbeat: Optional[Callable[[HeartbeatRecord], None]],
+        total_words: float,
+        train_words: float,
+        K: int,
+    ) -> EmbeddingPair:
+        """Multi-process fit with BOTH input sharding and the on-device pair
+        generator: each process packs token blocks for its plan.num_data /
+        process_count data segments only; one process_allgather per dispatch round
+        ships (tokens, starts, ordinal bases, valid counts, expected-kept clock
+        deltas, alive flags, stream positions) to every process, which assembles
+        the identical [K, Sd, T] global token feed and derives identical alphas —
+        the _fit_sharded lockstep protocol (see its docstring) carrying ~1
+        byte/pair of raw tokens instead of 4 bytes/pair of packed pairs.
+
+        Segment streams are deterministic per (seed, iteration, segment) and
+        independent of the producing process (_device_seg_blocks), so the
+        assembled feed — and therefore training — is bit-identical to the
+        single-process device-feed run on the same mesh (tested:
+        tests/test_multiprocess.py).
+
+        Unlike _fit_sharded (which lets local streams cross iteration boundaries
+        freely), this path holds an ITERATION BARRIER so the update sequence is
+        bit-identical to the single-process run: every round, each process offers
+        its next chunk, the round's iteration is the minimum over live offers,
+        and only chunks AT that iteration are consumed — a process already in
+        iteration k+1 contributes zeroed segments (exactly the zero blocks the
+        single-process stream pads exhausted segments with) and retains its chunk
+        for a later round. Alphas use the single-process convention
+        ((k-1)·train_words + within-iteration kept cumsum), reconstructed
+        identically everywhere from allgathered kept sums.
+        TrainState.shard_progress records each process's last CONSUMED
+        (iteration, step); resume needs the same process count.
+        """
+        from jax.experimental import multihost_utils
+
+        from glint_word2vec_tpu.data.hashrng import (
+            STREAM_SUBSAMPLE, STREAM_WINDOW, stream_base)
+        cfg = self.config
+        S = jax.process_count()
+        pid = jax.process_index()
+        Sd = self.plan.num_data
+        spp = Sd // S
+        own = list(range(pid * spp, (pid + 1) * spp))
+        T = self._tokens_per_step
+        tok_dt = self._pair_dtype
+        nbytes = (T + 7) // 8
+
+        start_iter = self.state.iteration
+        skip = self.state.batches_done if not self.state.finished else 0
+        if self.state.shard_progress is not None:
+            sp = self.state.shard_progress
+            if self.state.shard_feed != "tokens":
+                # pairs-sharded positions count b_local PAIR-batches, not token
+                # rows; pre-round-4 checkpoints (shard_feed None) are pairs too
+                raise ValueError(
+                    "checkpoint shard_progress indexes the host-feed pair "
+                    "streams (shard_feed="
+                    f"{self.state.shard_feed!r}); resume it with "
+                    "device_pairgen=False — token-step positions are a "
+                    "different stream")
+            if len(sp) != S:
+                raise ValueError(
+                    f"checkpoint shard_progress has {len(sp)} entries but this "
+                    f"run has {S} processes; resume sharded-input runs with the "
+                    "same process count")
+            start_iter, skip = int(sp[pid][0]), int(sp[pid][1])
+        elif skip:
+            # a single-process device-feed stream keeps emitting rows while ANY
+            # of its Sd segments is alive; a process's local stream here ends at
+            # its OWN segments' exhaustion — the two step counts drift apart near
+            # iteration ends, so a mid-iteration single-process position cannot
+            # be mapped exactly onto per-process streams
+            raise ValueError(
+                "checkpoint was written mid-iteration by a single-process "
+                "device-feed run; it cannot be resumed exactly across processes "
+                "— resume single-process (or from an iteration boundary)")
+
+        b = np.arange(cfg.window, dtype=np.float64)
+        rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()
+
+        def local_stream():
+            """This process's chunks: K step-rows of spp [T]-token segment blocks
+            + per-row expected-kept counts and this iteration's hash bases. Pure
+            numpy — safe on the producer thread (the allgather, a device
+            collective, must run on the main thread in identical order
+            everywhere)."""
+            for k in range(start_iter, cfg.num_iterations + 1):
+                sub_b = np.asarray(
+                    [stream_base(cfg.seed, STREAM_SUBSAMPLE, k, s) for s in own],
+                    np.uint32)
+                win_b = np.asarray(
+                    [stream_base(cfg.seed, STREAM_WINDOW, k, s) for s in own],
+                    np.uint32)
+                iters = [self._device_seg_blocks(sentences, k, s) for s in own]
+                steps_in_iter = skip if k == start_iter else 0
+                to_skip = skip if k == start_iter else 0
+                pending: List[tuple] = []
+
+                def flush():
+                    nonlocal pending, steps_in_iter
+                    real = len(pending)
+                    steps_in_iter += real
+                    while len(pending) < K:
+                        pending.append((np.zeros((spp, T), tok_dt),
+                                        np.zeros((spp, nbytes), np.uint8),
+                                        np.zeros(spp, np.float32),
+                                        np.zeros((spp, 2), np.int32), 0.0))
+                    out = dict(
+                        tokens=np.stack([p[0] for p in pending]),
+                        starts=np.stack([p[1] for p in pending]),
+                        nvalid=np.stack([p[2] for p in pending]),
+                        obase=np.stack([p[3] for p in pending]),
+                        kept=np.asarray([p[4] for p in pending], np.float32),
+                        sub_bases=sub_b, win_bases=win_b,
+                        iteration=k, batches_done=steps_in_iter, real=real)
+                    pending = []
+                    return out
+
+                while True:
+                    rows = []
+                    exp_kept = 0.0
+                    exhausted = 0
+                    for it in iters:
+                        blk = next(it, None)
+                        if blk is None:
+                            exhausted += 1
+                            rows.append((np.zeros(T, tok_dt),
+                                         np.zeros(nbytes, np.uint8), 0, 0, 0.0))
+                        else:
+                            rows.append(blk)
+                            exp_kept += blk[4]
+                    if exhausted == spp:
+                        break
+                    if to_skip:
+                        to_skip -= 1
+                        continue
+                    tokens = np.stack([r[0] for r in rows])
+                    starts = np.stack([r[1] for r in rows])
+                    nvalid = np.asarray([r[2] for r in rows], np.float32)
+                    obase = np.asarray(
+                        [[r[3] & 0xFFFFFFFF, r[3] >> 32] for r in rows],
+                        np.uint32).view(np.int32)
+                    pending.append((tokens, starts, nvalid, obase,
+                                    np.float32(exp_kept)))
+                    if len(pending) == K:
+                        yield flush()
+                if pending:
+                    yield flush()
+
+        if cfg.prefetch_chunks > 0:
+            chunks = _threaded_iter(local_stream(), cfg.prefetch_chunks)
+        else:
+            chunks = iter(local_stream())
+
+        cur_iter, cur_batches = start_iter, skip  # last CONSUMED position
+        # barrier state: the iteration currently training and its cumulative
+        # kept-word clock. On resume the within-iteration clock is rebuilt from
+        # the saved word count (exact to < 1 word — the int() truncation of the
+        # analytic iteration base; same approximation class as the saved clock
+        # itself, and resumed runs match uninterrupted ones to the suite's 1e-4
+        # standard, not bitwise)
+        round_iter = self.state.iteration
+        iter_kept = max(0.0, float(self.state.words_processed)
+                        - (round_iter - 1) * train_words)
+        held = None             # produced-but-not-yet-consumed local chunk
+        exhausted = False
+        est_total = 0.0
+        pairs_arrays: List[jax.Array] = []
+        dropped_arrays: List[jax.Array] = []
+        self._start_run_bookkeeping()
+        zero = dict(tokens=np.zeros((K, spp, T), tok_dt),
+                    starts=np.zeros((K, spp, nbytes), np.uint8),
+                    nvalid=np.zeros((K, spp), np.float32),
+                    obase=np.zeros((K, spp, 2), np.int32),
+                    kept=np.zeros(K, np.float32),
+                    sub_bases=np.zeros(spp, np.uint32),
+                    win_bases=np.zeros(spp, np.uint32))
+        try:
+            while True:
+                if held is None and not exhausted:
+                    t0 = time.perf_counter()
+                    held = next(chunks, None)
+                    self.host_wait_time += time.perf_counter() - t0
+                    if held is None:
+                        exhausted = True
+                offer = held if held is not None else dict(
+                    zero, iteration=cur_iter, batches_done=cur_batches, real=0)
+
+                t0 = time.perf_counter()
+                g = multihost_utils.process_allgather({
+                    "tokens": offer["tokens"], "starts": offer["starts"],
+                    "nvalid": offer["nvalid"], "obase": offer["obase"],
+                    "kept": offer["kept"],
+                    "sub": offer["sub_bases"], "win": offer["win_bases"],
+                    "real": np.asarray([offer["real"]], np.int32),
+                    "iter": np.asarray([offer["iteration"]], np.int64),
+                    "obatches": np.asarray([offer["batches_done"]], np.int64),
+                    "alive": np.asarray([0 if exhausted else 1], np.int32),
+                    "prog": np.asarray([cur_iter, cur_batches], np.int64),
+                })  # every leaf gains a leading [S] process axis
+                alive = g["alive"][:, 0] > 0                        # [S]
+                if not alive.any():
+                    break
+                # iteration barrier: this round trains the minimum live
+                # iteration; offers from a later iteration are NOT consumed —
+                # their segments ride as zeros (exactly the zero blocks the
+                # single-process stream pads exhausted segments with) and their
+                # owners re-offer them next round
+                round_it = int(g["iter"][alive, 0].min())
+                use = alive & (g["iter"][:, 0] == round_it)         # [S]
+                if round_it != round_iter:
+                    round_iter, iter_kept = round_it, 0.0
+                usef = use.astype(np.float32)
+                # segment axis assembly: [S, K, spp, ...] -> [K, S*spp=Sd, ...]
+                arrays = {
+                    "tokens": np.transpose(
+                        g["tokens"] * use[:, None, None, None].astype(tok_dt),
+                        (1, 0, 2, 3)).reshape(K, Sd, T),
+                    "starts": np.transpose(
+                        g["starts"] * use[:, None, None, None].astype(np.uint8),
+                        (1, 0, 2, 3)).reshape(K, Sd, nbytes),
+                    "obase": np.transpose(
+                        g["obase"] * use[:, None, None, None].astype(np.int32),
+                        (1, 0, 2, 3)).reshape(K, Sd, 2),
+                }
+                nvalid = np.transpose(
+                    g["nvalid"] * usef[:, None, None], (1, 0, 2)).reshape(K, Sd)
+                sub_bases = g["sub"].reshape(Sd)
+                win_bases = g["win"].reshape(Sd)
+                kept_step = (g["kept"].astype(np.float64)
+                             * usef[:, None]).sum(axis=0)           # [K]
+                # the single-process alpha convention: analytic iteration base
+                # plus the within-iteration kept cumsum (identical on every
+                # process — all inputs are allgathered values)
+                clocks = ((round_it - 1) * train_words + iter_kept
+                          + np.cumsum(kept_step))
+                iter_kept += float(kept_step.sum())
+                alphas = np.asarray(
+                    [alpha_schedule(float(w), total_words, cfg.learning_rate,
+                                    cfg.min_alpha_factor) for w in clocks],
+                    np.float32)
+                meta = np.concatenate([alphas[None, :], nvalid.T])  # [1+Sd, K]
+                # used processes pad only their final chunk per iteration, so
+                # real rows are prefixes; the longest prefix is the row count
+                real = int(g["real"][use, 0].max())
+                est_pairs = float(kept_step.sum()) * rate_per_kept
+                est_total += est_pairs
+
+                stacked = put_global(self._chunk_shardings, arrays)
+                self.params, (metrics, dropped) = self._step_fn(
+                    self.params, stacked, meta,
+                    np.int32(self.global_step + 1),
+                    self._table_prob, self._table_alias,
+                    self._keep_prob_dev, sub_bases, win_bases)
+                self.dispatch_time += time.perf_counter() - t0
+                pairs_arrays.append(metrics.pairs)
+                dropped_arrays.append(dropped)
+                if use[pid] and held is not None:
+                    cur_iter, cur_batches = held["iteration"], held["batches_done"]
+                    held = None
+                # prog in THIS round's allgather predates the consumption above,
+                # so the persisted position is (use ? offer : prog): a consumed
+                # offer IS the process's new position, a held one was not trained
+                prog = [[int(g["iter"][s, 0]) if use[s] else int(g["prog"][s, 0]),
+                         int(g["obatches"][s, 0]) if use[s]
+                         else int(g["prog"][s, 1])]
+                        for s in range(S)]
+                self._finish_round(
+                    real, est_pairs, meta[0], metrics,
+                    TrainState(
+                        iteration=round_it,
+                        words_processed=int(clocks[max(real - 1, 0)]),
+                        # meaningless across shards — resume uses shard_progress
+                        batches_done=0,
+                        shard_progress=prog, shard_feed="tokens"),
+                    checkpoint_path, checkpoint_every_steps, on_heartbeat)
+        finally:
+            self._stop_profiler()
+            closer = getattr(chunks, "close", None)
+            if closer is not None:
+                closer()
+
+        self._settle_device_pairgen_books(pairs_arrays, dropped_arrays, est_total)
         self.state = TrainState(
             iteration=cfg.num_iterations,
             words_processed=int(cfg.num_iterations * train_words),
@@ -1127,6 +1458,14 @@ class Trainer:
         skip = self.state.batches_done if not self.state.finished else 0
         if self.state.shard_progress is not None:
             sp = self.state.shard_progress
+            if self.state.shard_feed not in (None, "pairs"):
+                # device-feed positions count token-step rows, not b_local
+                # pair-batches (None = pre-round-4 checkpoint, always pairs)
+                raise ValueError(
+                    "checkpoint shard_progress indexes the device-feed token "
+                    f"streams (shard_feed={self.state.shard_feed!r}); resume "
+                    "it with device_pairgen=True — pair-batch positions are a "
+                    "different stream")
             if len(sp) != S:
                 raise ValueError(
                     f"checkpoint shard_progress has {len(sp)} entries but this run "
@@ -1306,7 +1645,8 @@ class Trainer:
                         # resume MUST use shard_progress, so persist 0 here rather
                         # than the writing process's local count
                         batches_done=0,
-                        shard_progress=[[int(a), int(b_)] for a, b_ in g["prog"]]),
+                        shard_progress=[[int(a), int(b_)] for a, b_ in g["prog"]],
+                        shard_feed="pairs"),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
         finally:
             self._stop_profiler()

@@ -50,7 +50,8 @@ cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
                      steps_per_dispatch=2, seed=7,
                      cbow=(mode == "cbow"),
-                     shard_input=(mode in ("sharded", "resume", "cbow")))
+                     device_pairgen=(mode == "device"),
+                     shard_input=(mode in ("sharded", "resume", "cbow", "device")))
 plan = make_mesh(2, 4)   # spans both processes: 8 global devices
 encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 
@@ -102,7 +103,8 @@ if mode == "resume":
 else:
     trainer = Trainer(cfg, vocab, plan=plan)
     assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
-    assert trainer._feed_segments == (2 if mode in ("sharded", "cbow") else 1)
+    assert trainer._feed_segments == (
+        2 if mode in ("sharded", "cbow", "device") else 1)
     trainer.fit(encoded)
     checksum = checksum_of(trainer)
     assert np.isfinite(checksum)
@@ -155,6 +157,44 @@ def test_two_process_cbow_sharded_feed(tmp_path):
     """CBOW on the sharded-input feed (round-4: the allgather protocol carries the
     grouped centers/contexts/count arrays, not just packed pairs)."""
     _run_two(tmp_path, "cbow")
+
+
+@pytest.mark.slow
+def test_two_process_device_pairgen_sharded_feed(tmp_path):
+    """device_pairgen across processes (round-4): each process packs token blocks
+    for its own data segments only; the iteration-barrier allgather protocol
+    (trainer._fit_device_feed_sharded) makes the 2-process run train on the
+    byte-identical feed the single-process device-feed run sees — asserted here
+    by matching the single-process run's checksum and exact pair count."""
+    line = _run_two(tmp_path, "device")
+    got = float(line.split()[1])
+    got_pairs = float(line.split()[5])
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(64)]
+    sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
+    vocab = build_vocab(sentences, min_count=1)
+    cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
+                         num_iterations=2, window=3, negatives=3,
+                         negative_pool=16, steps_per_dispatch=2, seed=7,
+                         device_pairgen=True, shard_input=True)
+    plan = make_mesh(2, 4)
+    trainer = Trainer(cfg, vocab, plan=plan)
+    trainer.fit(encode_sentences(sentences, vocab, cfg.max_sentence_length))
+    want = float(jax.jit(
+        lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(trainer.params))
+    assert got_pairs == trainer.pairs_trained, (got_pairs, trainer.pairs_trained)
+    assert abs(got - want) < 1e-6 * max(1.0, abs(want)), (got, want)
 
 
 @pytest.mark.slow
