@@ -608,8 +608,9 @@ class Trainer:
             # mis-position the resume
             raise ValueError(
                 "checkpoint was written by a sharded-input multi-process run "
-                f"({len(self.state.shard_progress)} shards); resume it with the same "
-                "process count and shard_input=True, not on the replicated feed")
+                f"({len(self.state.shard_progress)} shards); resume it with the "
+                "same process count, shard_input=True and device_pairgen="
+                f"{self.state.shard_feed == 'tokens'}, not on the replicated feed")
         start_iter = self.state.iteration
         # exact-step resume: the batch stream is deterministic per (seed, iteration,
         # shard), so skipping the recorded number of already-trained batches reproduces
@@ -813,6 +814,41 @@ class Trainer:
         if rest_tok.shape[0]:
             yield emit(rest_tok, rest_start)
 
+    def _device_step_rows(self, sentences: Sequence[np.ndarray], k: int, segs):
+        """One entry per step-row over the given data segments, stacked across
+        them: (tokens [n, T], start_bits [n, ·], nvalid [n] f32, obase [n, 2]
+        i32, exp_kept). A segment that exhausts before the others rides as zero
+        blocks (nvalid 0 — masked on device); the stream ends when every listed
+        segment is exhausted. The uint64→2×int32 ordinal-base split packing
+        lives only here; both the single-process and the sharded device-feed
+        chunk streams consume this shape."""
+        T = self._tokens_per_step
+        tok_dt = self._pair_dtype
+        nbytes = (T + 7) // 8
+        iters = [self._device_seg_blocks(sentences, k, s) for s in segs]
+        while True:
+            rows = []
+            exp_kept = 0.0
+            exhausted = 0
+            for it in iters:
+                blk = next(it, None)
+                if blk is None:
+                    exhausted += 1
+                    rows.append((np.zeros(T, tok_dt),
+                                 np.zeros(nbytes, np.uint8), 0, 0, 0.0))
+                else:
+                    rows.append(blk)
+                    exp_kept += blk[4]
+            if exhausted == len(iters):
+                return
+            tokens = np.stack([r[0] for r in rows])
+            starts = np.stack([r[1] for r in rows])
+            nvalid = np.asarray([r[2] for r in rows], np.float32)
+            obase = np.asarray(
+                [[r[3] & 0xFFFFFFFF, r[3] >> 32] for r in rows],
+                np.uint32).view(np.int32)
+            yield (tokens, starts, nvalid, obase, exp_kept)
+
     def _fit_device_feed(
         self,
         sentences: Sequence[np.ndarray],
@@ -844,15 +880,17 @@ class Trainer:
         if self.state.shard_progress is not None and not self.state.finished:
             raise ValueError(
                 "checkpoint was written by a sharded-input multi-process run; "
-                "resume it with the same process count, not with device_pairgen")
+                "resume it with the same process count and "
+                + ("device_pairgen=True (its positions index token-step rows)"
+                   if self.state.shard_feed == "tokens"
+                   else "device_pairgen=False (its positions index the host-"
+                        "feed pair streams)"))
         start_iter = self.state.iteration
         skip_steps = self.state.batches_done if not self.state.finished else 0
         # analytic pairs/step estimate — heartbeat display only; exact totals come
         # back from the device (see end of method)
         b = np.arange(cfg.window, dtype=np.float64)
         rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()
-
-        seg_blocks = lambda k, s: self._device_seg_blocks(sentences, k, s)
 
         def chunk_stream():
             for k in range(start_iter, cfg.num_iterations + 1):
@@ -863,7 +901,6 @@ class Trainer:
                 win_bases = np.asarray(
                     [stream_base(cfg.seed, STREAM_WINDOW, k, s)
                      for s in range(Sd)], np.uint32)
-                iters = [seg_blocks(k, s) for s in range(Sd)]
                 clock = 0.0
                 steps_in_iter = skip_steps if k == start_iter else 0
                 to_skip = skip_steps if k == start_iter else 0
@@ -900,34 +937,12 @@ class Trainer:
                     pending, pending_words = [], []
                     return out
 
-                while True:
-                    step_rows = []
-                    exp_kept = 0.0
-                    exhausted = 0
-                    for it in iters:
-                        blk = next(it, None)
-                        if blk is None:
-                            exhausted += 1
-                            step_rows.append((np.zeros(T, tok_dt),
-                                              np.zeros((T + 7) // 8, np.uint8),
-                                              0, 0, 0.0))
-                        else:
-                            step_rows.append(blk)
-                            exp_kept += blk[4]
-                    if exhausted == Sd:
-                        break
-                    clock += exp_kept
+                for row in self._device_step_rows(sentences, k, range(Sd)):
+                    clock += row[4]
                     if to_skip:
                         to_skip -= 1
                         continue
-                    tokens = np.stack([r[0] for r in step_rows])
-                    starts = np.stack([r[1] for r in step_rows])
-                    nvalid = np.asarray([r[2] for r in step_rows], np.float32)
-                    obase = np.asarray(
-                        [[r[3] & 0xFFFFFFFF, r[3] >> 32] for r in step_rows],
-                        np.uint32).view(np.int32)
-                    pending.append((tokens, starts, nvalid, obase,
-                                    exp_kept))
+                    pending.append(row)
                     pending_words.append(prev_words + clock)
                     if len(pending) == K:
                         yield flush()
@@ -1114,7 +1129,6 @@ class Trainer:
                 win_b = np.asarray(
                     [stream_base(cfg.seed, STREAM_WINDOW, k, s) for s in own],
                     np.uint32)
-                iters = [self._device_seg_blocks(sentences, k, s) for s in own]
                 steps_in_iter = skip if k == start_iter else 0
                 to_skip = skip if k == start_iter else 0
                 pending: List[tuple] = []
@@ -1139,32 +1153,11 @@ class Trainer:
                     pending = []
                     return out
 
-                while True:
-                    rows = []
-                    exp_kept = 0.0
-                    exhausted = 0
-                    for it in iters:
-                        blk = next(it, None)
-                        if blk is None:
-                            exhausted += 1
-                            rows.append((np.zeros(T, tok_dt),
-                                         np.zeros(nbytes, np.uint8), 0, 0, 0.0))
-                        else:
-                            rows.append(blk)
-                            exp_kept += blk[4]
-                    if exhausted == spp:
-                        break
+                for row in self._device_step_rows(sentences, k, own):
                     if to_skip:
                         to_skip -= 1
                         continue
-                    tokens = np.stack([r[0] for r in rows])
-                    starts = np.stack([r[1] for r in rows])
-                    nvalid = np.asarray([r[2] for r in rows], np.float32)
-                    obase = np.asarray(
-                        [[r[3] & 0xFFFFFFFF, r[3] >> 32] for r in rows],
-                        np.uint32).view(np.int32)
-                    pending.append((tokens, starts, nvalid, obase,
-                                    np.float32(exp_kept)))
+                    pending.append(row[:4] + (np.float32(row[4]),))
                     if len(pending) == K:
                         yield flush()
                 if pending:

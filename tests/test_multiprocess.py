@@ -50,8 +50,9 @@ cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
                      steps_per_dispatch=2, seed=7,
                      cbow=(mode == "cbow"),
-                     device_pairgen=(mode == "device"),
-                     shard_input=(mode in ("sharded", "resume", "cbow", "device")))
+                     device_pairgen=(mode in ("device", "dresume")),
+                     shard_input=(mode in ("sharded", "resume", "cbow", "device",
+                                           "dresume")))
 plan = make_mesh(2, 4)   # spans both processes: 8 global devices
 encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 
@@ -60,7 +61,7 @@ def checksum_of(trainer):
     return float(jax.jit(lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(
         trainer.params))
 
-if mode == "resume":
+if mode in ("resume", "dresume"):
     # uninterrupted run -> reference params
     t_ref = Trainer(cfg, vocab, plan=plan)
     assert t_ref._feed_segments == 2
@@ -195,6 +196,15 @@ def test_two_process_device_pairgen_sharded_feed(tmp_path):
         lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(trainer.params))
     assert got_pairs == trainer.pairs_trained, (got_pairs, trainer.pairs_trained)
     assert abs(got - want) < 1e-6 * max(1.0, abs(want)), (got, want)
+
+
+@pytest.mark.slow
+def test_two_process_device_pairgen_resume(tmp_path):
+    """Interrupt a 2-process device-feed run at its first mid-run checkpoint and
+    resume from the row-shards checkpoint: shard_progress indexes token-step rows
+    (shard_feed="tokens") and the within-iteration lr clock is rebuilt from the
+    saved word count, so the resumed run matches the uninterrupted one."""
+    _run_two(tmp_path, "dresume")
 
 
 @pytest.mark.slow
