@@ -75,10 +75,20 @@ class TrainState:
     pure function of (seed, iteration, shard), so skipping ``batches_done`` batches
     reproduces the interrupted run's position).
 
-    ``shard_progress`` (sharded-input multi-process runs only) records the per-process
-    stream positions ``[[iteration, batches_done], ...]`` indexed by process id — each
-    process's local stream advances at its own rate, so one (iteration, batches_done)
-    pair cannot describe all of them. None on single-process / replicated-feed runs.
+    ``shard_progress`` records sharded stream positions; what an entry indexes depends
+    on ``shard_feed``:
+
+    - ``"pairs"`` (host-feed sharded runs, _fit_sharded): per-PROCESS
+      ``[[iteration, local pair-batches done], ...]`` indexed by process id — resume
+      needs the same process count.
+    - ``"tokens"`` (device-feed runs): per-SEGMENT
+      ``[[iteration, blocks consumed], ...]`` indexed by data segment. Segments are
+      deterministic and process-independent, so resume is ELASTIC: any process count
+      dividing the mesh data degree (including 1) can pick the positions up.
+      Single-process device-feed checkpoints carry these alongside their own exact
+      ``batches_done``.
+
+    None on replicated-feed and host-feed single-process runs.
     """
 
     iteration: int = 1
@@ -88,10 +98,12 @@ class TrainState:
     batches_done: int = 0
     shard_progress: Optional[List[List[int]]] = None
     # which stream shard_progress positions index: "pairs" (_fit_sharded's
-    # per-process pair-batch streams) or "tokens" (_fit_device_feed_sharded's
-    # token-step rows). The two count different things, so resuming one with the
-    # other would silently mis-position; None on single-process checkpoints and
-    # on pre-round-4 sharded ones (accepted as "pairs", the only kind then)
+    # per-process pair-batch streams) or "tokens" (per-SEGMENT device-feed
+    # block positions — written by EVERY device-feed run, single-process
+    # included, for elastic resume). The two count different things, so
+    # resuming one with the other would silently mis-position; None on
+    # host-feed single-process checkpoints and on pre-round-4 sharded ones
+    # (accepted as "pairs", the only kind then)
     shard_feed: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:

@@ -603,14 +603,18 @@ class Trainer:
                 sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
                 total_words, float(train_words), K)
         if self.state.shard_progress is not None and not self.state.finished:
-            # batches_done from a sharded-input run counts B/N-pair local-shard
-            # batches — applying it to the full replicated stream would silently
-            # mis-position the resume
+            # the recorded positions index a different stream than the
+            # replicated pair feed — resuming here would silently mis-position
+            if self.state.shard_feed == "tokens":
+                raise ValueError(
+                    "checkpoint was written by a device-feed run (its "
+                    "positions index per-segment token streams); resume it "
+                    "with device_pairgen=True")
             raise ValueError(
                 "checkpoint was written by a sharded-input multi-process run "
                 f"({len(self.state.shard_progress)} shards); resume it with the "
-                "same process count, shard_input=True and device_pairgen="
-                f"{self.state.shard_feed == 'tokens'}, not on the replicated feed")
+                "same process count and shard_input=True, not on the "
+                "replicated feed")
         start_iter = self.state.iteration
         # exact-step resume: the batch stream is deterministic per (seed, iteration,
         # shard), so skipping the recorded number of already-trained batches reproduces
@@ -814,23 +818,42 @@ class Trainer:
         if rest_tok.shape[0]:
             yield emit(rest_tok, rest_start)
 
-    def _device_step_rows(self, sentences: Sequence[np.ndarray], k: int, segs):
+    def _device_step_rows(self, sentences: Sequence[np.ndarray], k: int, segs,
+                          skips=None, counts=None):
         """One entry per step-row over the given data segments, stacked across
         them: (tokens [n, T], start_bits [n, ·], nvalid [n] f32, obase [n, 2]
         i32, exp_kept). A segment that exhausts before the others rides as zero
         blocks (nvalid 0 — masked on device); the stream ends when every listed
         segment is exhausted. The uint64→2×int32 ordinal-base split packing
         lives only here; both the single-process and the sharded device-feed
-        chunk streams consume this shape."""
+        chunk streams consume this shape.
+
+        ``skips`` (resume): per-segment block counts to fast-forward before
+        joining — -1 means the segment already finished this iteration (empty
+        from the start, no production cost). ``counts``: optional list updated
+        in place with each segment's consumed-block total (skips included) —
+        the per-SEGMENT positions elastic resume persists."""
         T = self._tokens_per_step
         tok_dt = self._pair_dtype
         nbytes = (T + 7) // 8
-        iters = [self._device_seg_blocks(sentences, k, s) for s in segs]
+        iters = []
+        for i, s in enumerate(segs):
+            skip = 0 if skips is None else skips[i]
+            if skip < 0:
+                iters.append(iter(()))
+                continue
+            it = self._device_seg_blocks(sentences, k, s)
+            for _ in range(skip):
+                if next(it, None) is None:
+                    break
+            iters.append(it)
+            if counts is not None:
+                counts[i] += skip
         while True:
             rows = []
             exp_kept = 0.0
             exhausted = 0
-            for it in iters:
+            for i, it in enumerate(iters):
                 blk = next(it, None)
                 if blk is None:
                     exhausted += 1
@@ -839,6 +862,8 @@ class Trainer:
                 else:
                     rows.append(blk)
                     exp_kept += blk[4]
+                    if counts is not None:
+                        counts[i] += 1
             if exhausted == len(iters):
                 return
             tokens = np.stack([r[0] for r in rows])
@@ -877,16 +902,25 @@ class Trainer:
         Sd = self.plan.num_data
         T = self._tokens_per_step
         tok_dt = self._pair_dtype
+        seg_state = None
         if self.state.shard_progress is not None and not self.state.finished:
-            raise ValueError(
-                "checkpoint was written by a sharded-input multi-process run; "
-                "resume it with the same process count and "
-                + ("device_pairgen=True (its positions index token-step rows)"
-                   if self.state.shard_feed == "tokens"
-                   else "device_pairgen=False (its positions index the host-"
-                        "feed pair streams)"))
-        start_iter = self.state.iteration
-        skip_steps = self.state.batches_done if not self.state.finished else 0
+            if self.state.shard_feed != "tokens":
+                raise ValueError(
+                    "checkpoint was written by a host-feed sharded-input run "
+                    "(its positions index per-process pair streams); resume it "
+                    "with the same process count and device_pairgen=False")
+            # elastic shrink: a multi-process device-feed checkpoint records
+            # per-SEGMENT (iteration, blocks) positions — one process can pick
+            # all of them up (_device_seg_resume_state validates the count).
+            # Single-process-written checkpoints (batches_done > 0) keep the
+            # legacy row-level skip: it rebuilds the lr clock exactly, where
+            # the per-segment path is exact to < 1 clock word
+            if self.state.batches_done == 0:
+                seg_state = self._device_seg_resume_state()
+        start_iter = (min(it for it, _ in seg_state) if seg_state
+                      else self.state.iteration)
+        skip_steps = (self.state.batches_done
+                      if not (self.state.finished or seg_state) else 0)
         # analytic pairs/step estimate — heartbeat display only; exact totals come
         # back from the device (see end of method)
         b = np.arange(cfg.window, dtype=np.float64)
@@ -901,9 +935,30 @@ class Trainer:
                 win_bases = np.asarray(
                     [stream_base(cfg.seed, STREAM_WINDOW, k, s)
                      for s in range(Sd)], np.uint32)
-                clock = 0.0
-                steps_in_iter = skip_steps if k == start_iter else 0
-                to_skip = skip_steps if k == start_iter else 0
+                if seg_state:
+                    # Elastic resume from per-segment positions: fast-forward
+                    # each segment's block stream independently — recomputed
+                    # for EVERY k (entries may sit at different iterations,
+                    # e.g. an exhausted process frozen an iteration behind the
+                    # rest). The skipped rows' kept counts (the within-
+                    # iteration lr clock) are rebuilt from the saved word
+                    # count (exact to < 1 word) for the iteration the
+                    # checkpoint was saved in; earlier catch-up iterations
+                    # yield no rows at all, later ones start fresh.
+                    skips = [blocks if it == k else (-1 if it > k else 0)
+                             for it, blocks in seg_state]
+                    clock = (max(0.0, float(self.state.words_processed)
+                                 - prev_words)
+                             if k == self.state.iteration else 0.0)
+                    steps_in_iter = max(
+                        [b for it, b in seg_state if it == k], default=0)
+                    to_skip = 0
+                else:
+                    skips = None
+                    clock = 0.0
+                    steps_in_iter = skip_steps if k == start_iter else 0
+                    to_skip = skip_steps if k == start_iter else 0
+                counts = [0] * Sd  # filled in place by _device_step_rows
                 pending: List[tuple] = []
                 pending_words: List[float] = []
 
@@ -929,15 +984,25 @@ class Trainer:
                     meta = np.concatenate([alphas[None, :], nvalid.T])  # [1+Sd, K]
                     est_pairs = sum(p[4] for p in pending) * rate_per_kept
                     steps_in_iter += real
+                    # per-segment positions after this chunk — what elastic
+                    # resume (any process count) reads back
+                    sprog = [(seg_state[s] if skips and skips[s] < 0
+                              else [k, counts[s]]) for s in range(Sd)]
                     out = dict(
                         arrays=arrays, meta=meta, real=real, iteration=k,
                         words_processed=int(pending_words[real - 1]),
-                        batches_done=steps_in_iter, est_pairs=est_pairs,
-                        sub_bases=sub_bases, win_bases=win_bases)
+                        # after an elastic (per-segment) resume the joined rows
+                        # are offset from the canonical stream, so a row count
+                        # would mis-position a later legacy resume — persist 0
+                        # and let sprog stay the authoritative position
+                        batches_done=0 if seg_state else steps_in_iter,
+                        est_pairs=est_pairs,
+                        sub_bases=sub_bases, win_bases=win_bases, sprog=sprog)
                     pending, pending_words = [], []
                     return out
 
-                for row in self._device_step_rows(sentences, k, range(Sd)):
+                for row in self._device_step_rows(sentences, k, range(Sd),
+                                                  skips=skips, counts=counts):
                     clock += row[4]
                     if to_skip:
                         to_skip -= 1
@@ -987,7 +1052,13 @@ class Trainer:
                     real, chunk["est_pairs"], chunk["meta"][0], metrics,
                     TrainState(iteration=chunk["iteration"],
                                words_processed=chunk["words_processed"],
-                               batches_done=chunk["batches_done"]),
+                               batches_done=chunk["batches_done"],
+                               # per-segment positions so a multi-process run
+                               # can pick this checkpoint up (elastic grow);
+                               # this path's own resume uses batches_done
+                               shard_progress=[[int(a), int(b)]
+                                               for a, b in chunk["sprog"]],
+                               shard_feed="tokens"),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
         finally:
             self._stop_profiler()
@@ -1031,6 +1102,39 @@ class Trainer:
                         "(%.3f%%)", dropped_total,
                         100.0 * dropped_total / max(exact, 1.0))
 
+    def _device_seg_resume_state(self) -> List[List[int]]:
+        """Validated per-SEGMENT (iteration, blocks-consumed) resume positions
+        for the device feed — [plan.num_data] entries in segment order. Fresh
+        runs (and finished states) start every segment at (state.iteration, 0).
+        Entries are per segment, not per process, so any process count dividing
+        the mesh data degree can consume them (elastic restart)."""
+        Sd = self.plan.num_data
+        st = self.state
+        if st.shard_progress is None or st.finished:
+            if st.batches_done and not st.finished and jax.process_count() > 1:
+                # a pre-elastic single-process position counts joined step ROWS
+                # (zero-filled segments included) — not mappable to per-segment
+                # block positions
+                raise ValueError(
+                    "checkpoint was written mid-iteration by a pre-elastic "
+                    "device-feed run (no per-segment positions); resume it "
+                    "single-process (or from an iteration boundary)")
+            return [[st.iteration, 0] for _ in range(Sd)]
+        if st.shard_feed != "tokens":
+            # pairs-sharded positions count b_local PAIR-batches per process,
+            # not token blocks; pre-round-4 checkpoints (shard_feed None) too
+            raise ValueError(
+                "checkpoint shard_progress indexes the host-feed pair streams "
+                f"(shard_feed={st.shard_feed!r}); resume it with "
+                "device_pairgen=False — token positions are a different stream")
+        if len(st.shard_progress) != Sd:
+            raise ValueError(
+                f"checkpoint shard_progress has {len(st.shard_progress)} "
+                f"entries but the mesh data degree is {Sd}; device-feed "
+                "positions are per data segment — resume on a mesh with the "
+                "same data degree")
+        return [[int(a), int(b)] for a, b in st.shard_progress]
+
     def _fit_device_feed_sharded(
         self,
         sentences: Sequence[np.ndarray],
@@ -1066,8 +1170,14 @@ class Trainer:
         for a later round. Alphas use the single-process convention
         ((k-1)·train_words + within-iteration kept cumsum), reconstructed
         identically everywhere from allgathered kept sums.
-        TrainState.shard_progress records each process's last CONSUMED
-        (iteration, step); resume needs the same process count.
+
+        ELASTIC RESUME: TrainState.shard_progress records, per DATA SEGMENT (not
+        per process), the last consumed (iteration, blocks) position. Segments
+        are the real stream unit — deterministic and process-independent — so a
+        checkpoint written on N processes resumes on ANY M with
+        mesh data degree % M == 0, including M=1 (the single-process device-feed
+        path reads the same entries). The reference has no analog: its recovery
+        story is Spark task retry against mutated PS state (SURVEY §5).
         """
         from jax.experimental import multihost_utils
 
@@ -1083,45 +1193,21 @@ class Trainer:
         tok_dt = self._pair_dtype
         nbytes = (T + 7) // 8
 
-        start_iter = self.state.iteration
-        skip = self.state.batches_done if not self.state.finished else 0
-        if self.state.shard_progress is not None:
-            sp = self.state.shard_progress
-            if self.state.shard_feed != "tokens":
-                # pairs-sharded positions count b_local PAIR-batches, not token
-                # rows; pre-round-4 checkpoints (shard_feed None) are pairs too
-                raise ValueError(
-                    "checkpoint shard_progress indexes the host-feed pair "
-                    "streams (shard_feed="
-                    f"{self.state.shard_feed!r}); resume it with "
-                    "device_pairgen=False — token-step positions are a "
-                    "different stream")
-            if len(sp) != S:
-                raise ValueError(
-                    f"checkpoint shard_progress has {len(sp)} entries but this "
-                    f"run has {S} processes; resume sharded-input runs with the "
-                    "same process count")
-            start_iter, skip = int(sp[pid][0]), int(sp[pid][1])
-        elif skip:
-            # a single-process device-feed stream keeps emitting rows while ANY
-            # of its Sd segments is alive; a process's local stream here ends at
-            # its OWN segments' exhaustion — the two step counts drift apart near
-            # iteration ends, so a mid-iteration single-process position cannot
-            # be mapped exactly onto per-process streams
-            raise ValueError(
-                "checkpoint was written mid-iteration by a single-process "
-                "device-feed run; it cannot be resumed exactly across processes "
-                "— resume single-process (or from an iteration boundary)")
+        # per-own-segment last consumed (iteration, blocks) — the elastic-resume
+        # positions; fresh runs start every segment at (state.iteration, 0)
+        seg_state = self._device_seg_resume_state()[pid * spp:(pid + 1) * spp]
+        start_iter = min(it for it, _ in seg_state)
 
         b = np.arange(cfg.window, dtype=np.float64)
         rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()
 
         def local_stream():
             """This process's chunks: K step-rows of spp [T]-token segment blocks
-            + per-row expected-kept counts and this iteration's hash bases. Pure
-            numpy — safe on the producer thread (the allgather, a device
-            collective, must run on the main thread in identical order
-            everywhere)."""
+            + per-row expected-kept counts, this iteration's hash bases, and the
+            per-own-segment (iteration, blocks) positions AFTER the chunk (the
+            elastic-resume snapshot). Pure numpy — safe on the producer thread
+            (the allgather, a device collective, must run on the main thread in
+            identical order everywhere)."""
             for k in range(start_iter, cfg.num_iterations + 1):
                 sub_b = np.asarray(
                     [stream_base(cfg.seed, STREAM_SUBSAMPLE, k, s) for s in own],
@@ -1129,19 +1215,24 @@ class Trainer:
                 win_b = np.asarray(
                     [stream_base(cfg.seed, STREAM_WINDOW, k, s) for s in own],
                     np.uint32)
-                steps_in_iter = skip if k == start_iter else 0
-                to_skip = skip if k == start_iter else 0
+                # -1 = segment already past iteration k (finished it before the
+                # checkpoint); its entry must survive the snapshot untouched
+                skips = [blocks if it == k else (-1 if it > k else 0)
+                         for it, blocks in seg_state]
+                counts = [0] * spp  # filled in place by _device_step_rows
                 pending: List[tuple] = []
 
                 def flush():
-                    nonlocal pending, steps_in_iter
+                    nonlocal pending
                     real = len(pending)
-                    steps_in_iter += real
                     while len(pending) < K:
                         pending.append((np.zeros((spp, T), tok_dt),
                                         np.zeros((spp, nbytes), np.uint8),
                                         np.zeros(spp, np.float32),
                                         np.zeros((spp, 2), np.int32), 0.0))
+                    sprog = np.asarray(
+                        [seg_state[i] if skips[i] < 0 else [k, counts[i]]
+                         for i in range(spp)], np.int64)
                     out = dict(
                         tokens=np.stack([p[0] for p in pending]),
                         starts=np.stack([p[1] for p in pending]),
@@ -1149,14 +1240,12 @@ class Trainer:
                         obase=np.stack([p[3] for p in pending]),
                         kept=np.asarray([p[4] for p in pending], np.float32),
                         sub_bases=sub_b, win_bases=win_b,
-                        iteration=k, batches_done=steps_in_iter, real=real)
+                        iteration=k, sprog=sprog, real=real)
                     pending = []
                     return out
 
-                for row in self._device_step_rows(sentences, k, own):
-                    if to_skip:
-                        to_skip -= 1
-                        continue
+                for row in self._device_step_rows(
+                        sentences, k, own, skips=skips, counts=counts):
                     pending.append(row[:4] + (np.float32(row[4]),))
                     if len(pending) == K:
                         yield flush()
@@ -1168,7 +1257,7 @@ class Trainer:
         else:
             chunks = iter(local_stream())
 
-        cur_iter, cur_batches = start_iter, skip  # last CONSUMED position
+        cur_sprog = np.asarray(seg_state, np.int64)  # [spp, 2] last CONSUMED
         # barrier state: the iteration currently training and its cumulative
         # kept-word clock. On resume the within-iteration clock is rebuilt from
         # the saved word count (exact to < 1 word — the int() truncation of the
@@ -1200,7 +1289,8 @@ class Trainer:
                     if held is None:
                         exhausted = True
                 offer = held if held is not None else dict(
-                    zero, iteration=cur_iter, batches_done=cur_batches, real=0)
+                    zero, iteration=int(cur_sprog[:, 0].max()),
+                    sprog=cur_sprog, real=0)
 
                 t0 = time.perf_counter()
                 g = multihost_utils.process_allgather({
@@ -1210,9 +1300,9 @@ class Trainer:
                     "sub": offer["sub_bases"], "win": offer["win_bases"],
                     "real": np.asarray([offer["real"]], np.int32),
                     "iter": np.asarray([offer["iteration"]], np.int64),
-                    "obatches": np.asarray([offer["batches_done"]], np.int64),
+                    "sprog": np.asarray(offer["sprog"], np.int64),
                     "alive": np.asarray([0 if exhausted else 1], np.int32),
-                    "prog": np.asarray([cur_iter, cur_batches], np.int64),
+                    "prog": cur_sprog,
                 })  # every leaf gains a leading [S] process axis
                 alive = g["alive"][:, 0] > 0                        # [S]
                 if not alive.any():
@@ -1272,21 +1362,22 @@ class Trainer:
                 pairs_arrays.append(metrics.pairs)
                 dropped_arrays.append(dropped)
                 if use[pid] and held is not None:
-                    cur_iter, cur_batches = held["iteration"], held["batches_done"]
+                    cur_sprog = np.asarray(held["sprog"], np.int64)
                     held = None
                 # prog in THIS round's allgather predates the consumption above,
-                # so the persisted position is (use ? offer : prog): a consumed
-                # offer IS the process's new position, a held one was not trained
-                prog = [[int(g["iter"][s, 0]) if use[s] else int(g["prog"][s, 0]),
-                         int(g["obatches"][s, 0]) if use[s]
-                         else int(g["prog"][s, 1])]
-                        for s in range(S)]
+                # so each SEGMENT's persisted position comes from its owner's
+                # offer if consumed, else from its last consumed snapshot — a
+                # held offer was not trained
+                prog = [[int(a), int(b)]
+                        for s in range(S)
+                        for a, b in (g["sprog"][s] if use[s] else g["prog"][s])]
                 self._finish_round(
                     real, est_pairs, meta[0], metrics,
                     TrainState(
                         iteration=round_it,
                         words_processed=int(clocks[max(real - 1, 0)]),
-                        # meaningless across shards — resume uses shard_progress
+                        # meaningless across segments — resume uses the
+                        # per-segment shard_progress
                         batches_done=0,
                         shard_progress=prog, shard_feed="tokens"),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)

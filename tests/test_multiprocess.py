@@ -50,9 +50,10 @@ cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
                      steps_per_dispatch=2, seed=7,
                      cbow=(mode == "cbow"),
-                     device_pairgen=(mode in ("device", "dresume")),
+                     device_pairgen=(mode in ("device", "dresume", "eshrink",
+                                              "egrow")),
                      shard_input=(mode in ("sharded", "resume", "cbow", "device",
-                                           "dresume")))
+                                           "dresume", "eshrink", "egrow")))
 plan = make_mesh(2, 4)   # spans both processes: 8 global devices
 encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 
@@ -61,17 +62,12 @@ def checksum_of(trainer):
     return float(jax.jit(lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(
         trainer.params))
 
-if mode in ("resume", "dresume"):
-    # uninterrupted run -> reference params
-    t_ref = Trainer(cfg, vocab, plan=plan)
-    assert t_ref._feed_segments == 2
-    t_ref.fit(encoded)
-    want = checksum_of(t_ref)
-    # interrupted run: checkpoint every 4 global steps, stop after the first save
-    ck = os.path.join(workdir, "ck")
-    t1 = Trainer(cfg, vocab, plan=plan)
+def stop_after_first_checkpoint(trainer, encoded, ck):
+    # run fit with periodic checkpointing, aborting right after the first
+    # mid-run save: leaves a valid mid-iteration checkpoint at ck
     seen = []
-    class Stop(Exception): pass
+    class Stop(Exception):
+        pass
     orig = Trainer.save_checkpoint
     def save_once(self, path):
         orig(self, path)
@@ -80,11 +76,40 @@ if mode in ("resume", "dresume"):
             raise Stop()
     Trainer.save_checkpoint = save_once
     try:
-        t1.fit(encoded, checkpoint_path=ck, checkpoint_every_steps=4)
+        trainer.fit(encoded, checkpoint_path=ck, checkpoint_every_steps=4)
     except Stop:
         pass
-    Trainer.save_checkpoint = orig
+    finally:
+        Trainer.save_checkpoint = orig
     assert seen, "no mid-run checkpoint happened"
+
+if mode == "eshrink":
+    # 2-process interrupted device-feed run; the parent resumes it on ONE process
+    stop_after_first_checkpoint(Trainer(cfg, vocab, plan=plan),
+                                encoded, os.path.join(workdir, "ck"))
+    print("STOPPED ok", flush=True)
+elif mode == "egrow":
+    # resume (2 processes) from a single-process checkpoint the parent wrote
+    # (dense layout — every process loads the same host arrays; Trainer places)
+    ck = os.path.join(workdir, "ck")
+    from glint_word2vec_tpu.train.checkpoint import load_model
+    m = load_model(ck)
+    st = m["train_state"]
+    assert st.shard_feed == "tokens" and len(st.shard_progress) == 2
+    from glint_word2vec_tpu.ops.sgns import EmbeddingPair
+    t2 = Trainer(cfg, vocab, plan=plan,
+                 params=EmbeddingPair(m["syn0"], m["syn1"]), train_state=st)
+    t2.fit(encoded)
+    print(f"CHECKSUM {checksum_of(t2):.10e} steps {t2.global_step}", flush=True)
+elif mode in ("resume", "dresume"):
+    # uninterrupted run -> reference params
+    t_ref = Trainer(cfg, vocab, plan=plan)
+    assert t_ref._feed_segments == 2
+    t_ref.fit(encoded)
+    want = checksum_of(t_ref)
+    # interrupted run: checkpoint every 4 global steps, stop after the first save
+    ck = os.path.join(workdir, "ck")
+    stop_after_first_checkpoint(Trainer(cfg, vocab, plan=plan), encoded, ck)
     from glint_word2vec_tpu.train.checkpoint import load_model_header, load_params_into_plan
     header = load_model_header(ck)
     st = header["train_state"]
@@ -114,7 +139,7 @@ else:
 """
 
 
-def _run_two(tmp_path, mode):
+def _run_two(tmp_path, mode, marker="CHECKSUM"):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = str(s.getsockname()[1])
@@ -134,10 +159,70 @@ def _run_two(tmp_path, mode):
         out, err = p.communicate(timeout=420)
         assert p.returncode == 0, f"worker failed:\nstdout:{out}\nstderr:{err[-3000:]}"
         outs.append(out)
-    lines = [next(ln for ln in o.splitlines() if ln.startswith("CHECKSUM"))
+    lines = [next(ln for ln in o.splitlines() if ln.startswith(marker))
              for o in outs]
     assert lines[0] == lines[1], f"processes disagree: {lines}"
     return lines[0]
+
+
+def _parent_device_setup():
+    """The worker script's corpus/config/mesh, rebuilt in the parent process
+    (8 local virtual devices, single process) for cross-topology comparisons."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(64)]
+    sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
+    vocab = build_vocab(sentences, min_count=1)
+    cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
+                         num_iterations=2, window=3, negatives=3,
+                         negative_pool=16, steps_per_dispatch=2, seed=7,
+                         device_pairgen=True, shard_input=True)
+    plan = make_mesh(2, 4)
+    encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
+
+    def checksum(trainer):
+        return float(jax.jit(
+            lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(
+                trainer.params))
+
+    return vocab, encoded, cfg, plan, checksum
+
+
+def _interrupt_at_first_checkpoint(trainer, encoded, ck):
+    """Run fit with periodic checkpointing, aborting right after the first
+    mid-run save — leaves a valid mid-iteration checkpoint at ck. (The worker
+    script carries its own copy; it is self-contained source text.)"""
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    seen = []
+
+    class Stop(Exception):
+        pass
+
+    orig = Trainer.save_checkpoint
+
+    def save_once(self, path):
+        orig(self, path)
+        seen.append(self.state.global_step)
+        if len(seen) == 1:
+            raise Stop()
+
+    Trainer.save_checkpoint = save_once
+    try:
+        trainer.fit(encoded, checkpoint_path=ck, checkpoint_every_steps=4)
+    except Stop:
+        pass
+    finally:
+        Trainer.save_checkpoint = orig
+    assert seen, "no mid-run checkpoint happened"
 
 
 @pytest.mark.slow
@@ -171,31 +256,89 @@ def test_two_process_device_pairgen_sharded_feed(tmp_path):
     got = float(line.split()[1])
     got_pairs = float(line.split()[5])
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from glint_word2vec_tpu.config import Word2VecConfig
-    from glint_word2vec_tpu.data.pipeline import encode_sentences
-    from glint_word2vec_tpu.data.vocab import build_vocab
-    from glint_word2vec_tpu.parallel.mesh import make_mesh
     from glint_word2vec_tpu.train.trainer import Trainer
 
-    rng = np.random.default_rng(0)
-    words = [f"w{i}" for i in range(64)]
-    sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
-    vocab = build_vocab(sentences, min_count=1)
-    cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
-                         num_iterations=2, window=3, negatives=3,
-                         negative_pool=16, steps_per_dispatch=2, seed=7,
-                         device_pairgen=True, shard_input=True)
-    plan = make_mesh(2, 4)
+    vocab, encoded, cfg, plan, checksum = _parent_device_setup()
     trainer = Trainer(cfg, vocab, plan=plan)
-    trainer.fit(encode_sentences(sentences, vocab, cfg.max_sentence_length))
-    want = float(jax.jit(
-        lambda p: jnp.sum(p.syn0) + 1000.0 * jnp.sum(p.syn1))(trainer.params))
+    trainer.fit(encoded)
+    want = checksum(trainer)
     assert got_pairs == trainer.pairs_trained, (got_pairs, trainer.pairs_trained)
     assert abs(got - want) < 1e-6 * max(1.0, abs(want)), (got, want)
+
+
+@pytest.mark.slow
+def test_elastic_resume_shrink_two_to_one(tmp_path):
+    """ELASTIC restart, N -> 1: interrupt a 2-process device-feed run at its
+    first checkpoint, then resume it on a SINGLE process. Device-feed positions
+    are per data segment (process-independent), so the single process picks up
+    all segments and the result matches the uninterrupted single-process run
+    (to the < 1-word lr-clock rebuild tolerance)."""
+    _run_two(tmp_path, "eshrink", marker="STOPPED")
+
+    from glint_word2vec_tpu.ops.sgns import EmbeddingPair
+    from glint_word2vec_tpu.parallel.mesh import (
+        pad_dim_to_lanes, pad_vocab_for_sharding)
+    from glint_word2vec_tpu.train.checkpoint import (
+        load_model_header, load_params_into_plan)
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    vocab, encoded, cfg, plan, checksum = _parent_device_setup()
+    ref = Trainer(cfg, vocab, plan=plan)
+    ref.fit(encoded)
+    want = checksum(ref)
+
+    ck = str(tmp_path / "ck")
+    st = load_model_header(ck)["train_state"]
+    assert st.shard_feed == "tokens" and len(st.shard_progress) == 2
+    pv = pad_vocab_for_sharding(vocab.size, plan.num_model)
+    pd = pad_dim_to_lanes(cfg.vector_size, cfg.pad_vector_to_lanes)
+    syn0, syn1 = load_params_into_plan(ck, plan, pv, pd)
+    t2 = Trainer(cfg, vocab, plan=plan, params=EmbeddingPair(syn0, syn1),
+                 train_state=st)
+    t2.fit(encoded)
+    got = checksum(t2)
+    assert abs(got - want) < 1e-4 * max(1.0, abs(want)), (got, want)
+
+    # double-resume: a checkpoint written AFTER an elastic resume has row
+    # counts offset from the canonical stream, so it must persist
+    # batches_done=0 and keep the per-segment positions authoritative — a
+    # second resume then lands correctly too
+    from glint_word2vec_tpu.train.checkpoint import load_model
+    syn0b, syn1b = load_params_into_plan(ck, plan, pv, pd)
+    t3 = Trainer(cfg, vocab, plan=plan, params=EmbeddingPair(syn0b, syn1b),
+                 train_state=st)
+    ck2 = str(tmp_path / "ck2")
+    _interrupt_at_first_checkpoint(t3, encoded, ck2)
+    m2 = load_model(ck2)
+    st2 = m2["train_state"]
+    assert st2.batches_done == 0 and st2.shard_feed == "tokens"
+    t4 = Trainer(cfg, vocab, plan=plan,
+                 params=EmbeddingPair(m2["syn0"], m2["syn1"]), train_state=st2)
+    t4.fit(encoded)
+    got2 = checksum(t4)
+    assert abs(got2 - want) < 1e-4 * max(1.0, abs(want)), (got2, want)
+
+
+@pytest.mark.slow
+def test_elastic_resume_grow_one_to_two(tmp_path):
+    """ELASTIC restart, 1 -> N: interrupt a single-process device-feed run at
+    its first checkpoint (which now records per-segment positions alongside its
+    own batches_done), then resume it on 2 processes; the result matches the
+    uninterrupted single-process run."""
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    vocab, encoded, cfg, plan, checksum = _parent_device_setup()
+    ref = Trainer(cfg, vocab, plan=plan)
+    ref.fit(encoded)
+    want = checksum(ref)
+
+    # interrupted single-process run -> mid-iteration checkpoint at tmp_path/ck
+    _interrupt_at_first_checkpoint(
+        Trainer(cfg, vocab, plan=plan), encoded, str(tmp_path / "ck"))
+
+    line = _run_two(tmp_path, "egrow")
+    got = float(line.split()[1])
+    assert abs(got - want) < 1e-4 * max(1.0, abs(want)), (got, want)
 
 
 @pytest.mark.slow
