@@ -347,6 +347,15 @@ def bench_scale_1m() -> dict:
     out["find_synonyms_ms"] = (time.perf_counter() - t0) / 5 * 1e3
     log(f"V=1M find_synonyms(top-10): {out['find_synonyms_ms']:.1f} ms/query "
         "(matvec + top-k over 1M rows)")
+    # batched variant: per-query round trips dominate through the tunnel; one
+    # [64, V] dispatch amortizes them (models/word2vec.py find_synonyms_batch)
+    qs = [f"w{i + 10}" for i in range(64)]
+    model.find_synonyms_batch(qs, 10, chunk=64)  # compile + warm
+    t0 = time.perf_counter()
+    model.find_synonyms_batch(qs, 10, chunk=64)
+    out["find_synonyms_batch_ms"] = (time.perf_counter() - t0) / 64 * 1e3
+    log(f"V=1M find_synonyms_batch(64 queries): "
+        f"{out['find_synonyms_batch_ms']:.1f} ms/query")
     model.stop()
     return out
 

@@ -148,6 +148,16 @@ class Word2VecConfig:
                                     # fit() into this directory (view with TensorBoard
                                     # or xprof; complements the host-wait/dispatch
                                     # split the trainer always records)
+    feed_consistency_check: bool = False  # debug: on multi-process runs, fingerprint
+                                    # every assembled global batch and compare across
+                                    # processes (one tiny extra allgather per round) —
+                                    # catches SPMD feed divergence (nondeterministic
+                                    # host pipelines, clock drift) at the round it
+                                    # happens instead of as silent training divergence.
+                                    # The aux-subsystem analog of race detection: the
+                                    # reference ACCEPTED data races by design
+                                    # (Hogwild, SURVEY §5); the synchronous design can
+                                    # verify its no-divergence contract instead
     shard_input: bool = True        # multi-process runs: each process generates only its
                                     # own sentence shard (the repartition analog,
                                     # mllib:345) and per-round allgathers assemble the

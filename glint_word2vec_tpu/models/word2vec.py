@@ -222,26 +222,52 @@ class Word2VecModel:
     ) -> List[Tuple[str, float]]:
         """Top-``num`` cosine-similar words. String query excludes the query word itself
         (mllib:621-629); vector queries (for analogies) do not."""
-        self._check_alive()
-        if isinstance(query, str):
-            word: Optional[str] = query
-            vec = jnp.asarray(self.transform(query))
-        else:
-            word = None
-            vec = jnp.asarray(query, jnp.float32)
-        k = num + (1 if word is not None else 0)
-        k = min(k, self.num_words)
-        self.norms  # materialize the cached full-row norms
-        scores, idxs = _cosine_topk(self._full0, self._norms, vec, k, self.num_words)
-        out: List[Tuple[str, float]] = []
-        for i, s in zip(np.asarray(idxs), np.asarray(scores)):
-            w = self.vocab.words[int(i)]
-            if w == word:
-                continue
-            out.append((w, float(s)))
-        return out[:num]
+        return self.find_synonyms_batch([query], num)[0]
 
     find_synonyms_array = find_synonyms  # ml:405-420 naming alias
+
+    def find_synonyms_batch(
+        self,
+        queries: Sequence[Union[str, np.ndarray]],
+        num: int,
+        chunk: int = 128,
+    ) -> List[List[Tuple[str, float]]]:
+        """Batched :meth:`find_synonyms`: one device dispatch per ``chunk``
+        queries instead of one per query. Through a thin host→device link the
+        per-query round trip dominates (PERF.md §6: ~300 ms/query at V=1M rows);
+        batching amortizes it — the [chunk, V] cosine matrix rides one matmul.
+        Word queries exclude themselves (mllib:621-629); vector queries do not.
+        ``chunk`` bounds device memory at chunk·V·4 bytes of scores."""
+        self._check_alive()
+        self.norms  # materialize the cached full-row norms
+        out: List[List[Tuple[str, float]]] = []
+        k = min(num + 1, self.num_words)
+        for lo in range(0, len(queries), chunk):
+            part = queries[lo:lo + chunk]
+            words: List[Optional[str]] = []
+            rows = []
+            for q in part:
+                if isinstance(q, str):
+                    idx = self.vocab.get(q)
+                    if idx < 0:
+                        raise KeyError(f"{q} not in vocabulary")
+                    words.append(q)
+                    rows.append(self._full0[idx])
+                else:
+                    words.append(None)
+                    rows.append(jnp.asarray(q, jnp.float32))
+            scores, idxs = _cosine_topk_batch(
+                self._full0, self._norms, jnp.stack(rows), k, self.num_words)
+            for word, srow, irow in zip(words, np.asarray(scores),
+                                        np.asarray(idxs)):
+                res: List[Tuple[str, float]] = []
+                for i, s in zip(irow, srow):
+                    w = self.vocab.words[int(i)]
+                    if w == word:
+                        continue
+                    res.append((w, float(s)))
+                out.append(res[:num])
+        return out
 
     def analogy(self, a: str, b: str, c: str, num: int = 10) -> List[Tuple[str, float]]:
         """b − a + c vector arithmetic, excluding the three query words — the analogy
@@ -371,15 +397,19 @@ from functools import partial
 
 
 @partial(jax.jit, static_argnames=("k", "valid_rows"))
-def _cosine_topk(syn0: jax.Array, norms: jax.Array, query: jax.Array, k: int,
-                 valid_rows: int) -> Tuple[jax.Array, jax.Array]:
-    """cosine(rows, q) top-k: normalize query (snrm2/sscal analog, mllib:589-596),
-    sharded matvec (mllib:598), divide by row norms with zero-norm → 0 (mllib:601-609),
-    device top-k instead of the client-side BoundedPriorityQueue scan (mllib:611-619).
-    Rows past valid_rows are sharding padding, excluded outright."""
-    qn = jnp.linalg.norm(query)
-    q = query / jnp.maximum(qn, 1e-12)
-    dots = syn0 @ q
-    cos = jnp.where(norms > 0, dots / jnp.maximum(norms, 1e-12), 0.0)
-    cos = jnp.where(jnp.arange(cos.shape[0]) < valid_rows, cos, -jnp.inf)
+def _cosine_topk_batch(syn0: jax.Array, norms: jax.Array, queries: jax.Array,
+                       k: int, valid_rows: int) -> Tuple[jax.Array, jax.Array]:
+    """cosine(rows, q) top-k over a [Q, D] query matrix in ONE dispatch:
+    normalize queries (snrm2/sscal analog, mllib:589-596), the [Q, V] cosine
+    matrix as a single MXU matmul (mllib:598's matvec, batched), divide by row
+    norms with zero-norm → 0 (mllib:601-609), batched device top-k instead of
+    the client-side BoundedPriorityQueue scan (mllib:611-619). Rows past
+    valid_rows are sharding padding, excluded outright."""
+    qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
+    q = queries / jnp.maximum(qn, 1e-12)
+    dots = q @ syn0.T                                          # [Q, V]
+    cos = jnp.where(norms[None, :] > 0,
+                    dots / jnp.maximum(norms[None, :], 1e-12), 0.0)
+    cos = jnp.where(jnp.arange(cos.shape[1])[None, :] < valid_rows,
+                    cos, -jnp.inf)
     return jax.lax.top_k(cos, k)

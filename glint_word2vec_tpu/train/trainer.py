@@ -719,6 +719,10 @@ class Trainer:
                 if chunk is None:
                     break
                 t0 = time.perf_counter()
+                if cfg.feed_consistency_check and jax.process_count() > 1:
+                    # the replicated feed is the path where divergence CAN
+                    # happen: every process regenerated the stream itself
+                    self._assert_feed_consistent(chunk["arrays"], chunk["meta"])
                 stacked = (chunk["arrays"] if staged else
                            put_global(self._chunk_shardings, chunk["arrays"]))
                 real = chunk["real"]
@@ -843,12 +847,20 @@ class Trainer:
                 iters.append(iter(()))
                 continue
             it = self._device_seg_blocks(sentences, k, s)
+            consumed = 0
             for _ in range(skip):
                 if next(it, None) is None:
-                    break
+                    # shorter stream than the checkpointed position can only
+                    # mean the corpus changed since the checkpoint — replaying
+                    # silently would train the wrong data with wrong books
+                    raise ValueError(
+                        f"device-feed resume: segment {s} iteration {k} has "
+                        f"only {consumed} blocks but the checkpoint recorded "
+                        f"{skip} — the corpus does not match the checkpoint")
+                consumed += 1
             iters.append(it)
             if counts is not None:
-                counts[i] += skip
+                counts[i] += consumed
         while True:
             rows = []
             exp_kept = 0.0
@@ -1102,6 +1114,33 @@ class Trainer:
                         "(%.3f%%)", dropped_total,
                         100.0 * dropped_total / max(exact, 1.0))
 
+    def _assert_feed_consistent(self, arrays: dict, meta: np.ndarray) -> None:
+        """Debug-mode SPMD divergence detector (config.feed_consistency_check):
+        every process fingerprints its ASSEMBLED global feed + meta and one
+        allgather compares them. Identical step inputs on every process are the
+        contract that makes the jitted update SPMD-consistent; a mismatch here
+        (nondeterministic host pipeline, clock drift, corrupted transport)
+        would otherwise surface only as silent training divergence. Aux-
+        subsystem analog of race detection: the reference accepted races by
+        design (Hogwild, SURVEY §5) — a synchronous design can verify its
+        no-divergence contract instead."""
+        import zlib
+
+        from jax.experimental import multihost_utils
+        h = 0
+        for name in sorted(arrays):
+            h = zlib.crc32(np.ascontiguousarray(arrays[name]).tobytes(), h)
+        h = zlib.crc32(np.ascontiguousarray(meta).tobytes(), h)
+        fps = multihost_utils.process_allgather(
+            {"fp": np.asarray([h], np.int64)})["fp"][:, 0]
+        if not (fps == fps[0]).all():
+            raise RuntimeError(
+                "SPMD feed divergence: per-process fingerprints of the "
+                f"assembled global batch differ ({[int(f) for f in fps]}) — "
+                "host pipelines produced different feeds (nondeterministic "
+                "input ordering or clock drift); training would silently "
+                "diverge from here")
+
     def _device_seg_resume_state(self) -> List[List[int]]:
         """Validated per-SEGMENT (iteration, blocks-consumed) resume positions
         for the device feed — [plan.num_data] entries in segment order. Fresh
@@ -1352,6 +1391,9 @@ class Trainer:
                 est_pairs = float(kept_step.sum()) * rate_per_kept
                 est_total += est_pairs
 
+                if cfg.feed_consistency_check:
+                    self._assert_feed_consistent(
+                        dict(arrays, sub=sub_bases, win=win_bases), meta)
                 stacked = put_global(self._chunk_shardings, arrays)
                 self.params, (metrics, dropped) = self._step_fn(
                     self.params, stacked, meta,
@@ -1713,6 +1755,8 @@ class Trainer:
                 real = int((reals_all > 0).any(axis=0).sum())
                 real_pairs = float(reals_all.sum())
 
+                if cfg.feed_consistency_check:
+                    self._assert_feed_consistent(feed, meta)
                 stacked = put_global(self._chunk_shardings, feed)
                 self.params, metrics = self._step_fn(
                     self.params, stacked, meta,

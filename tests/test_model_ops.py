@@ -97,6 +97,24 @@ def test_find_synonyms_num_larger_than_vocab(model):
     assert len(res) == 4  # vocab minus query word
 
 
+def test_find_synonyms_batch_matches_per_query(model):
+    """find_synonyms_batch = find_synonyms per row, in one device dispatch per
+    chunk: word and vector queries mix, word queries exclude themselves, and a
+    chunk smaller than the query list exercises the chunking path."""
+    m, syn0 = model
+    queries = ["alpha", syn0[0], "gamma", "beta", syn0[3]]
+    batched = m.find_synonyms_batch(queries, 2, chunk=2)
+    assert len(batched) == len(queries)
+    for q, got in zip(queries, batched):
+        want = m.find_synonyms(q, 2)
+        assert [w for w, _ in got] == [w for w, _ in want]
+        # scores agree to matmul-association tolerance ([Q,V] vs [V] paths)
+        np.testing.assert_allclose([s for _, s in got], [s for _, s in want],
+                                   atol=1e-5)
+    with pytest.raises(KeyError, match="not in vocabulary"):
+        m.find_synonyms_batch(["alpha", "zzz"], 2)
+
+
 def test_analogy_excludes_queries(model):
     m, _ = model
     res = m.analogy("alpha", "beta", "gamma", num=2)

@@ -53,7 +53,10 @@ cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      device_pairgen=(mode in ("device", "dresume", "eshrink",
                                               "egrow")),
                      shard_input=(mode in ("sharded", "resume", "cbow", "device",
-                                           "dresume", "eshrink", "egrow")))
+                                           "dresume", "eshrink", "egrow")),
+                     # every 2-process test also exercises the SPMD divergence
+                     # detector on its real feeds (must stay silent)
+                     feed_consistency_check=True)
 plan = make_mesh(2, 4)   # spans both processes: 8 global devices
 encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 
@@ -83,7 +86,17 @@ def stop_after_first_checkpoint(trainer, encoded, ck):
         Trainer.save_checkpoint = orig
     assert seen, "no mid-run checkpoint happened"
 
-if mode == "eshrink":
+if mode == "fdiverge":
+    # negative path of the SPMD divergence detector: process-DEPENDENT data
+    # must be caught by the fingerprint allgather on every process
+    trainer = Trainer(cfg, vocab, plan=plan)
+    bad = {"x": np.full(8, pid, np.int32)}
+    try:
+        trainer._assert_feed_consistent(bad, np.zeros((2, 2), np.float32))
+        print("DIVERGE missed", flush=True)
+    except RuntimeError:
+        print("DIVERGE caught", flush=True)
+elif mode == "eshrink":
     # 2-process interrupted device-feed run; the parent resumes it on ONE process
     stop_after_first_checkpoint(Trainer(cfg, vocab, plan=plan),
                                 encoded, os.path.join(workdir, "ck"))
@@ -348,6 +361,15 @@ def test_two_process_device_pairgen_resume(tmp_path):
     (shard_feed="tokens") and the within-iteration lr clock is rebuilt from the
     saved word count, so the resumed run matches the uninterrupted one."""
     _run_two(tmp_path, "dresume")
+
+
+@pytest.mark.slow
+def test_feed_consistency_detector_catches_divergence(tmp_path):
+    """The SPMD feed-divergence detector (config.feed_consistency_check) must
+    flag process-dependent feed content; its silent pass on real feeds is
+    covered by every other 2-process test (the flag is on in the worker)."""
+    line = _run_two(tmp_path, "fdiverge", marker="DIVERGE")
+    assert line == "DIVERGE caught"
 
 
 @pytest.mark.slow
