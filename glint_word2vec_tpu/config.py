@@ -41,12 +41,25 @@ class Word2VecConfig:
     batch_size: int = 50            # batchSize (mllib:74) — reference centers-per-minibatch;
                                     # kept for decay/compat; device batching uses pairs_per_batch
     negatives: int = 5              # n (mllib:75)
-    subsample_ratio: float = 0.0    # subsampleRatio (mllib:77,190-194). 0 disables.
+    subsample_ratio: float = 1e-3   # subsampleRatio (mllib:77,190-194). 0 disables.
+                                    # Default 1e-3 (word2vec.c's/gensim's default):
+                                    # bounds EVAL.md's duplicate-overload channel — a
+                                    # frequent word's summed scatter updates in one
+                                    # large batch diverge with subsampling OFF — while
+                                    # staying sane on small corpora (1e-4 starves a
+                                    # 161k-word corpus below the reference's own
+                                    # semantic gates; 1e-3 passes them AND holds
+                                    # purity 1.0 at 17M words in EVAL_RUNS — though
+                                    # the same 17M rows measure analogy acc@1 0.71 at
+                                    # 1e-3 vs 0.99 at 1e-4, so tune per corpus:
+                                    # text8-scale-and-up corpora with large batches
+                                    # want ~1e-4, both for relational quality and for
+                                    # EVAL.md's long-run stability analysis).
                                     # NOTE: the reference's default is 1e-6, but its
                                     # formula divides Int/Long (mllib:374-376) so its
-                                    # subsampling is a silent no-op — "disabled" IS the
-                                    # reference's observed behavior. Setting >0 here uses
-                                    # the intended float formula (pipeline.py).
+                                    # subsampling is a silent no-op — the compat layer
+                                    # pins 0.0 to mirror that observed behavior. Setting
+                                    # >0 uses the intended float formula (pipeline.py).
     seed: int = 0                   # seed (mllib:71; random by default there, fixed here for
                                     # reproducibility — sync training makes runs deterministic)
 
@@ -79,7 +92,12 @@ class Word2VecConfig:
     # --- TPU-native knobs (no reference analog) ---
     pairs_per_batch: int = 8192     # (center, context) pairs per device step; the reference's
                                     # RPC-bound batchSize*window pairs/minibatch becomes one
-                                    # large fixed-shape jit step
+                                    # large fixed-shape jit step. Sized for realistic
+                                    # corpora (millions of words up); on toy corpora use
+                                    # a small batch (~256) — a 161k-word corpus at 8192
+                                    # pairs/step gets only ~20 coarse updates per epoch,
+                                    # too few for sharp analogy geometry (the toy
+                                    # integration suite's settings)
     sigmoid_mode: str = "exact"     # "exact" = jax.nn.sigmoid; "clipped" mirrors the reference
                                     # LUT clipping at |f| > 6 (mllib:246-248,292-302)
     duplicate_scaling: bool = False  # opt-in stabilizer: average (not sum) a row's updates

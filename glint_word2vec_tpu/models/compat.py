@@ -118,6 +118,13 @@ class ServerSideGlintWord2Vec:
         return self
 
     def setSubsampleRatio(self, value: float) -> "ServerSideGlintWord2Vec":
+        if value > 0:
+            warnings.warn(
+                "the reference's subsampling is a silent no-op at ANY setting "
+                "(Int/Long division bug, see data/pipeline.py) — here "
+                f"setSubsampleRatio({value}) actually subsamples, so results "
+                "will differ from a reference run with the same setting; pass "
+                "0.0 for behavior-faithful (no-op) parity", stacklevel=2)
         self._subsample_ratio = float(value)
         return self
 

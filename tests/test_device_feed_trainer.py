@@ -36,7 +36,7 @@ def test_device_feed_learns_topics():
     cfg = Word2VecConfig(
         vector_size=32, min_count=1, pairs_per_batch=256, num_iterations=5,
         learning_rate=0.025, seed=3, negative_pool=16, device_pairgen=True,
-        steps_per_dispatch=4, window=3)
+        steps_per_dispatch=4, window=3, subsample_ratio=0.0)
     trainer, vocab = _fit(cfg, _topic_corpus())
     syn0 = np.asarray(trainer.unpadded_params().syn0)
     wv = {w: syn0[vocab.index[w]] for w in "abxy"}
@@ -121,7 +121,7 @@ def test_device_feed_data_parallel_segments():
     cfg = Word2VecConfig(
         vector_size=16, min_count=1, pairs_per_batch=512, num_iterations=2,
         seed=5, negative_pool=8, device_pairgen=True, steps_per_dispatch=2,
-        window=3, num_data_shards=2)
+        window=3, num_data_shards=2, subsample_ratio=0.0)
     vocab = build_vocab(sentences, min_count=1)
     encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
     trainer = Trainer(cfg, vocab)
@@ -174,7 +174,8 @@ def test_device_feed_resume_is_deterministic(tmp_path):
         return Word2VecConfig(
             vector_size=16, min_count=1, pairs_per_batch=256, num_iterations=2,
             learning_rate=0.02, seed=9, negative_pool=8, device_pairgen=True,
-            steps_per_dispatch=2, window=3, prefetch_chunks=0)
+            steps_per_dispatch=2, window=3, prefetch_chunks=0,
+            subsample_ratio=0.0)
 
     full = Trainer(mk(), vocab)
     full.fit(encoded)

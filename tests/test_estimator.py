@@ -338,14 +338,16 @@ def test_stability_warnings_fire(caplog):
         subsample_ratio=1e-4))
     # duplicate overload: no subsampling, top word >300 dups per 64k batch
     assert any("duplicates" in m for m in warns(
-        pairs_per_batch=65536, negatives=5, negative_pool=1024))
+        pairs_per_batch=65536, negatives=5, negative_pool=1024,
+        subsample_ratio=0.0))
     # compounding band: both below individual thresholds, warned jointly
     msgs = warns(pairs_per_batch=65536, negatives=5, negative_pool=256,
                  subsample_ratio=1e-4)
     assert any("compound" in m for m in msgs), msgs
     # the duplicate channel is warned on the per-pair path too (negative_pool=0)
     assert any("duplicates" in m for m in warns(
-        pairs_per_batch=65536, negatives=5, negative_pool=0))
+        pairs_per_batch=65536, negatives=5, negative_pool=0,
+        subsample_ratio=0.0))
     # a safe config stays quiet
     assert not warns(pairs_per_batch=16384, negatives=5, negative_pool=64,
                      subsample_ratio=1e-4)

@@ -48,7 +48,7 @@ sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
 vocab = build_vocab(sentences, min_count=1)
 cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
-                     steps_per_dispatch=2, seed=7,
+                     steps_per_dispatch=2, seed=7, subsample_ratio=0.0,
                      cbow=(mode == "cbow"),
                      device_pairgen=(mode in ("device", "dresume", "eshrink",
                                               "egrow")),
@@ -197,7 +197,8 @@ def _parent_device_setup():
     cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                          num_iterations=2, window=3, negatives=3,
                          negative_pool=16, steps_per_dispatch=2, seed=7,
-                         device_pairgen=True, shard_input=True)
+                         subsample_ratio=0.0, device_pairgen=True,
+                         shard_input=True)
     plan = make_mesh(2, 4)
     encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 

@@ -122,7 +122,7 @@ def test_trainer_smoke_use_pallas():
     cfg = Word2VecConfig(
         vector_size=16, min_count=1, pairs_per_batch=128, num_iterations=1,
         window=3, negatives=3, negative_pool=16, use_pallas=True,
-        steps_per_dispatch=2, seed=2)
+        steps_per_dispatch=2, seed=2, subsample_ratio=0.0)
     plan = make_mesh(1, 1, devices=jax.devices()[:1])
     trainer = Trainer(cfg, vocab, plan=plan)
     before = np.asarray(trainer.params.syn0).copy()
