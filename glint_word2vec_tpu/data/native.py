@@ -33,18 +33,42 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
-def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
-           "-o", _LIB + ".tmp", _SRC]
+def build_or_reload(src: str, lib_path: str, abi_symbol: str, abi_version: int,
+                    std: str, what: str) -> Optional[ctypes.CDLL]:
+    """The shared build-on-first-use contract for every native component:
+    compile with g++ when the cached .so is missing or older than the source,
+    load, verify the ABI symbol, and rebuild once on a stale/broken cache.
+    Returns the CDLL or None (with a logged warning — callers fall back to
+    their pure-Python path). Argtype configuration and caching stay with the
+    calling module."""
+    def build() -> bool:
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", f"-std={std}",
+               "-o", lib_path + ".tmp", src]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError) as e:
+            err = getattr(e, "stderr", b"") or b""
+            logger.warning("native %s build failed (%s); using the Python "
+                           "path. stderr: %s", what, e,
+                           err.decode(errors="replace")[-500:])
+            return False
+        os.replace(lib_path + ".tmp", lib_path)
+        return True
+
+    needs_build = (not os.path.exists(lib_path)
+                   or os.path.getmtime(lib_path) < os.path.getmtime(src))
+    if needs_build and not build():
+        return None
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError) as e:
-        err = getattr(e, "stderr", b"") or b""
-        logger.warning("native pairgen build failed (%s); using the numpy pipeline. "
-                       "stderr: %s", e, err.decode(errors="replace")[-500:])
-        return False
-    os.replace(_LIB + ".tmp", _LIB)
-    return True
+        lib = ctypes.CDLL(lib_path)
+        if getattr(lib, abi_symbol)() != abi_version:
+            raise OSError(f"stale {os.path.basename(lib_path)} ABI; rebuild")
+    except OSError:
+        # stale or broken cache: rebuild once
+        if not build():
+            return None
+        lib = ctypes.CDLL(lib_path)
+    return lib
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -57,21 +81,11 @@ def _load() -> Optional[ctypes.CDLL]:
         if os.environ.get("GLINT_DISABLE_NATIVE"):
             _load_failed = True
             return None
-        needs_build = (not os.path.exists(_LIB)
-                       or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
-        if needs_build and not _build():
+        lib = build_or_reload(_SRC, _LIB, "glint_pairgen_abi_version",
+                              _ABI_VERSION, "c++17", "pairgen")
+        if lib is None:
             _load_failed = True
             return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-            if lib.glint_pairgen_abi_version() != _ABI_VERSION:
-                raise OSError("stale libpairgen.so ABI; rebuild")
-        except OSError:
-            # stale or broken cache: rebuild once
-            if not _build():
-                _load_failed = True
-                return None
-            lib = ctypes.CDLL(_LIB)
         lib.glint_block_pairs.restype = ctypes.c_int64
         lib.glint_block_pairs.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,   # tokens, n_tokens

@@ -1,0 +1,125 @@
+"""Parity tests for the native C++ ingestion passes (native/ingest.cpp):
+vocabulary counting and corpus encoding must be BIT-IDENTICAL to the Python
+path on ASCII-whitespace token files — including tie ordering (count desc,
+stable on first-seen), OOV dropping, empty lines, and max-sentence chunking."""
+
+import os
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.data import ingest_native
+from glint_word2vec_tpu.data.corpus import TokenFileCorpus, encode_corpus
+from glint_word2vec_tpu.data.vocab import Vocabulary, build_vocab, count_words
+
+pytestmark = pytest.mark.skipif(
+    not ingest_native.ingest_available(),
+    reason="native ingest unavailable (no toolchain)")
+
+CORPUS = """the quick brown fox jumps over the lazy dog
+the the the
+\tpad   spaced\ttokens here
+
+rare1 rare2 rare1
+a b c d e f g h i j k l m n o p q r s t u v w x y z
+zz zz zz yy yy xx
+"""
+
+
+@pytest.fixture()
+def corpus_file(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text(CORPUS, encoding="utf-8")
+    return str(p)
+
+
+def _python_vocab(path, min_count):
+    return Vocabulary.from_counter(
+        count_words(TokenFileCorpus(path)), min_count)
+
+
+def test_count_parity_and_tie_order(corpus_file):
+    c = TokenFileCorpus(corpus_file)
+    for mc in (1, 2, 3):
+        got = build_vocab(c, min_count=mc)        # native path
+        want = _python_vocab(corpus_file, mc)     # python Counter path
+        assert got.words == want.words, mc
+        np.testing.assert_array_equal(got.counts, want.counts)
+        assert got.train_words_count == want.train_words_count
+
+
+def test_encode_parity_including_chunking(corpus_file, tmp_path):
+    c = TokenFileCorpus(corpus_file)
+    vocab = build_vocab(c, min_count=2)           # drops the 26 rare singletons
+    for msl in (1000, 4, 1):                      # incl. aggressive chunking
+        nat_dir = str(tmp_path / f"nat{msl}")
+        enc_nat = encode_corpus(c, vocab, nat_dir, msl)     # native path
+        # python path: feed the parsed sentences (not a TokenFileCorpus) so the
+        # native gate does not trigger
+        py_dir = str(tmp_path / f"py{msl}")
+        enc_py = encode_corpus(list(c), vocab, py_dir, msl)
+        tn = np.memmap(os.path.join(nat_dir, "tokens.bin"), np.int32, "r")
+        tp = np.memmap(os.path.join(py_dir, "tokens.bin"), np.int32, "r")
+        on = np.memmap(os.path.join(nat_dir, "offsets.bin"), np.int64, "r")
+        op = np.memmap(os.path.join(py_dir, "offsets.bin"), np.int64, "r")
+        np.testing.assert_array_equal(np.asarray(tn), np.asarray(tp))
+        np.testing.assert_array_equal(np.asarray(on), np.asarray(op))
+        assert len(enc_nat) == len(enc_py)
+        assert enc_nat.total_tokens == enc_py.total_tokens
+
+
+def test_valid_utf8_multibyte_takes_native_path(tmp_path):
+    """Accented words are plain multi-byte UTF-8 — byte-level tokens match
+    Python's str tokens, so the native path applies and agrees."""
+    p = tmp_path / "c.txt"
+    p.write_text("österreich wien österreich\nüber über\n", encoding="utf-8")
+    got = build_vocab(TokenFileCorpus(str(p)), min_count=1)
+    want = _python_vocab(str(p), 1)
+    assert got.words == want.words
+    np.testing.assert_array_equal(got.counts, want.counts)
+    assert "österreich" in got.index
+
+
+def test_python_semantics_detector_falls_back(tmp_path, caplog):
+    """Corpora whose tokenization differs between Python and the ASCII
+    tokenizer — unicode whitespace, lone \\r line breaks, C0 separators —
+    must be detected and produce the PYTHON path's result."""
+    import logging
+    cases = [
+        "foo\u00a0bar baz\n",       # NBSP: Python splits it, ASCII would not
+        "foo\rbar\n",               # lone \r: a Python line break
+        "foo\u2028bar\n",           # LINE SEPARATOR
+        "a\x1cb\n",                 # C0 file separator (Python-split space)
+    ]
+    for text in cases:
+        p = tmp_path / "c.txt"
+        p.write_text(text, encoding="utf-8", newline="")
+        with caplog.at_level(logging.INFO, logger="glint_word2vec_tpu"):
+            got = build_vocab(TokenFileCorpus(str(p)), min_count=1)
+        want = _python_vocab(str(p), 1)
+        assert got.words == want.words, text.encode()
+        np.testing.assert_array_equal(got.counts, want.counts)
+
+
+def test_lowercase_corpora_stay_on_python_path(tmp_path, monkeypatch):
+    """The native tokenizer is ASCII-only; lowercase=True needs Python's
+    unicode lower(), so the gate must keep such corpora off the native path."""
+    p = tmp_path / "c.txt"
+    p.write_text("The QUICK fox\nThe fox\n", encoding="utf-8")
+    c = TokenFileCorpus(str(p), lowercase=True)
+    vocab = build_vocab(c, min_count=1)
+    assert "the" in vocab.index and "The" not in vocab.index
+
+
+def test_disable_native_env_falls_back(corpus_file, monkeypatch):
+    monkeypatch.setenv("GLINT_DISABLE_NATIVE", "1")
+    monkeypatch.setattr(ingest_native, "_lib", None)
+    monkeypatch.setattr(ingest_native, "_load_failed", False)
+    try:
+        assert not ingest_native.ingest_available()
+        got = build_vocab(TokenFileCorpus(corpus_file), min_count=1)
+        want = _python_vocab(corpus_file, 1)
+        assert got.words == want.words
+    finally:
+        monkeypatch.setattr(ingest_native, "_lib", None)
+        monkeypatch.setattr(ingest_native, "_load_failed", False)
