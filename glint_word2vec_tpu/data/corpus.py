@@ -118,13 +118,8 @@ def encode_corpus(
                 tok_p, off_p, native.default_threads())
             if res is not None:
                 total_n, n_sents = res
-                with open(os.path.join(out_dir, _META), "w",
-                          encoding="utf-8") as f:
-                    json.dump({"n_sentences": n_sents,
-                               "total_tokens": total_n,
-                               "max_sentence_length": max_sentence_length,
-                               "vocab_fingerprint": vocab_fingerprint(vocab)},
-                              f)
+                _write_meta(out_dir, n_sents, total_n, max_sentence_length,
+                            vocab)
                 return EncodedCorpus(out_dir)
     index = vocab.index
     offsets: List[int] = [0]
@@ -157,11 +152,18 @@ def encode_corpus(
         flush()
 
     np.asarray(offsets, dtype=np.int64).tofile(os.path.join(out_dir, _OFFSETS))
+    _write_meta(out_dir, len(offsets) - 1, total, max_sentence_length, vocab)
+    return EncodedCorpus(out_dir)
+
+
+def _write_meta(out_dir: str, n_sentences: int, total_tokens: int,
+                max_sentence_length: int, vocab: Vocabulary) -> None:
+    """The encoded-dir metadata — one schema for both the Python and the
+    native encode paths."""
     with open(os.path.join(out_dir, _META), "w", encoding="utf-8") as f:
-        json.dump({"n_sentences": len(offsets) - 1, "total_tokens": total,
+        json.dump({"n_sentences": n_sentences, "total_tokens": total_tokens,
                    "max_sentence_length": max_sentence_length,
                    "vocab_fingerprint": vocab_fingerprint(vocab)}, f)
-    return EncodedCorpus(out_dir)
 
 
 def vocab_fingerprint(vocab: Vocabulary) -> str:

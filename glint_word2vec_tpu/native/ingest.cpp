@@ -71,7 +71,8 @@ inline bool is_space(unsigned char c) {
 // str.split whitespace), lone \r (universal-newline line break), invalid
 // UTF-8 (errors="replace" merges distinct byte strings), and every unicode
 // whitespace code point Python splits on (0x85, 0xA0, 0x1680, 0x2000-0x200A,
-// 0x2028, 0x2029, 0x205F, 0x3000).
+// 0x2028, 0x2029, 0x202F, 0x205F, 0x3000 — the full set str.isspace() accepts
+// beyond ASCII, cross-checked against CPython).
 bool python_semantics_match(const unsigned char* p, const unsigned char* end) {
   while (p < end) {
     unsigned char c = *p;
@@ -100,7 +101,7 @@ bool python_semantics_match(const unsigned char* p, const unsigned char* end) {
     if (cp > 0x10FFFF) return false;
     if (cp == 0x85 || cp == 0xA0 || cp == 0x1680 ||
         (cp >= 0x2000 && cp <= 0x200A) || cp == 0x2028 || cp == 0x2029 ||
-        cp == 0x205F || cp == 0x3000)
+        cp == 0x202F || cp == 0x205F || cp == 0x3000)
       return false;                                    // unicode whitespace
     p += n + 1;
   }

@@ -98,6 +98,12 @@ def test_second_process_serves_checkpoint(tmp_path):
         vec = srv.ask(op="vector", word="w1")["vector"]
         np.testing.assert_allclose(vec, local.transform("w1"), rtol=1e-6)
 
+        # batched queries: one dispatch serves many words (PERF.md §6)
+        batch = srv.ask(op="synonyms_batch", words=["w0", "w1"],
+                        num=5)["synonyms"]
+        assert [w for w, _ in batch[0]] == [w for w, _ in want]
+        assert len(batch) == 2 and len(batch[1]) == 5
+
         # the trainer keeps going and writes a NEWER checkpoint at the same path;
         # the serving process picks it up with the reload op (mode-B lifecycle)
         trainer.fit(encode_sentences(_corpus(seed=5), vocab,

@@ -90,6 +90,7 @@ def test_python_semantics_detector_falls_back(tmp_path, caplog):
         "foo\rbar\n",               # lone \r: a Python line break
         "foo\u2028bar\n",           # LINE SEPARATOR
         "a\x1cb\n",                 # C0 file separator (Python-split space)
+        "foo\u202fbar baz\n",       # NARROW NBSP (the easy one to miss)
     ]
     for text in cases:
         p = tmp_path / "c.txt"

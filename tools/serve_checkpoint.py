@@ -13,6 +13,7 @@ object per line (the process-boundary analog of the reference's Akka query RPCs,
 the same ops the PS served: pull / multiply+top-k, mllib:514,598):
 
     {"op": "synonyms", "word": "berlin", "num": 10}
+    {"op": "synonyms_batch", "words": ["berlin", "wien"], "num": 10}
     {"op": "synonyms_vec", "vector": [...], "num": 10}
     {"op": "vector", "word": "berlin"}
     {"op": "reload"}                      # pick up a newer checkpoint at the same path
@@ -98,6 +99,12 @@ def main():
                 vec = np.asarray(req["vector"], np.float32)
                 res = model.find_synonyms(vec, int(req.get("num", 10)))
                 out({"synonyms": [[w, s] for w, s in res]})
+            elif op == "synonyms_batch":
+                # many queries, one device dispatch per chunk — through a thin
+                # link per-query round trips dominate (PERF.md §6)
+                res = model.find_synonyms_batch(
+                    list(req["words"]), int(req.get("num", 10)))
+                out({"synonyms": [[[w, s] for w, s in row] for row in res]})
             elif op == "vector":
                 out({"vector": model.transform(req["word"]).tolist()})
             elif op == "reload":
