@@ -51,10 +51,13 @@ class Word2VecConfig:
                                     # semantic gates; 1e-3 passes them AND holds
                                     # purity 1.0 at 17M words in EVAL_RUNS — though
                                     # the same 17M rows measure analogy acc@1 0.71 at
-                                    # 1e-3 vs 0.99 at 1e-4, so tune per corpus:
-                                    # text8-scale-and-up corpora with large batches
-                                    # want ~1e-4, both for relational quality and for
-                                    # EVAL.md's long-run stability analysis).
+                                    # 1e-3 vs 0.99 at 1e-4, so tune per corpus.
+                                    # HARD boundary, measured: 1e-3 with B=64k
+                                    # diverges at 60M words (duplicate channel, 336
+                                    # expected dups > the 300 threshold — the
+                                    # construction-time warning names exactly this);
+                                    # large-batch long runs want ~1e-4, which is also
+                                    # the best relational quality at scale.
                                     # NOTE: the reference's default is 1e-6, but its
                                     # formula divides Int/Long (mllib:374-376) so its
                                     # subsampling is a silent no-op — the compat layer

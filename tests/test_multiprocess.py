@@ -50,14 +50,17 @@ cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
                      steps_per_dispatch=2, seed=7, subsample_ratio=0.0,
                      cbow=(mode == "cbow"),
-                     device_pairgen=(mode in ("device", "dresume", "eshrink",
-                                              "egrow")),
+                     device_pairgen=(mode in ("device", "device42", "dresume",
+                                              "eshrink", "egrow")),
                      shard_input=(mode in ("sharded", "resume", "cbow", "device",
-                                           "dresume", "eshrink", "egrow")),
+                                           "device42", "dresume", "eshrink",
+                                           "egrow")),
                      # every 2-process test also exercises the SPMD divergence
                      # detector on its real feeds (must stay silent)
                      feed_consistency_check=True)
-plan = make_mesh(2, 4)   # spans both processes: 8 global devices
+# spans both processes: 8 global devices; device42 uses a 4-wide data axis so
+# each process owns TWO token segments (spp=2 in _fit_device_feed_sharded)
+plan = make_mesh(4, 2) if mode == "device42" else make_mesh(2, 4)
 encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 
 import jax.numpy as jnp
@@ -143,7 +146,7 @@ else:
     trainer = Trainer(cfg, vocab, plan=plan)
     assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
     assert trainer._feed_segments == (
-        2 if mode in ("sharded", "cbow", "device") else 1)
+        2 if mode in ("sharded", "cbow", "device", "device42") else 1)
     trainer.fit(encoded)
     checksum = checksum_of(trainer)
     assert np.isfinite(checksum)
@@ -260,20 +263,24 @@ def test_two_process_cbow_sharded_feed(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_device_pairgen_sharded_feed(tmp_path):
+@pytest.mark.parametrize("mode,mesh", [("device", (2, 4)), ("device42", (4, 2))])
+def test_two_process_device_pairgen_bit_identity(tmp_path, mode, mesh):
     """device_pairgen across processes (round-4): each process packs token blocks
     for its own data segments only; the iteration-barrier allgather protocol
     (trainer._fit_device_feed_sharded) makes the 2-process run train on the
     byte-identical feed the single-process device-feed run sees — asserted here
-    by matching the single-process run's checksum and exact pair count."""
-    line = _run_two(tmp_path, "device")
+    by matching the single-process run's checksum and exact pair count. The
+    (4, 2) mesh gives each process TWO token segments (spp=2 — exercises the
+    per-own-segment assembly, positions, and hash-base slices spp=1 cannot)."""
+    line = _run_two(tmp_path, mode)
     got = float(line.split()[1])
     got_pairs = float(line.split()[5])
 
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
     from glint_word2vec_tpu.train.trainer import Trainer
 
-    vocab, encoded, cfg, plan, checksum = _parent_device_setup()
-    trainer = Trainer(cfg, vocab, plan=plan)
+    vocab, encoded, cfg, _, checksum = _parent_device_setup()
+    trainer = Trainer(cfg, vocab, plan=make_mesh(*mesh))
     trainer.fit(encoded)
     want = checksum(trainer)
     assert got_pairs == trainer.pairs_trained, (got_pairs, trainer.pairs_trained)
