@@ -13,10 +13,12 @@ capped by the host's cores).
 from __future__ import annotations
 
 import ctypes
+import glob
 import logging
 import os
 import subprocess
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -42,8 +44,23 @@ def build_or_reload(src: str, lib_path: str, abi_symbol: str, abi_version: int,
     their pure-Python path). Argtype configuration and caching stay with the
     calling module."""
     def build() -> bool:
+        # per-process temp name: co-hosted builders (multi-process JAX workers,
+        # parallel pytest) must not interleave g++ output into one file before
+        # the atomic publish below
+        tmp = f"{lib_path}.tmp.{os.getpid()}"
+        # sweep temp objects orphaned by builders killed mid-compile (unique
+        # names mean nothing ever overwrites them); only files older than the
+        # build timeout — younger ones may belong to a live concurrent builder
+        for stale in glob.glob(lib_path + ".tmp*"):  # incl. legacy fixed ".tmp"
+            try:
+                if time.time() - os.path.getmtime(stale) > 300:
+                    os.unlink(stale)
+            except OSError:
+                pass
+        # _FILE_OFFSET_BITS=64: the ingest loader seeks with fseeko/off_t,
+        # which is only 64-bit on ILP32 glibc with this macro
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", f"-std={std}",
-               "-o", lib_path + ".tmp", src]
+               "-D_FILE_OFFSET_BITS=64", "-o", tmp, src]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         except (OSError, subprocess.SubprocessError) as e:
@@ -51,8 +68,12 @@ def build_or_reload(src: str, lib_path: str, abi_symbol: str, abi_version: int,
             logger.warning("native %s build failed (%s); using the Python "
                            "path. stderr: %s", what, e,
                            err.decode(errors="replace")[-500:])
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return False
-        os.replace(lib_path + ".tmp", lib_path)
+        os.replace(tmp, lib_path)
         return True
 
     needs_build = (not os.path.exists(lib_path)

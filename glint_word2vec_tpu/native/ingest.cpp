@@ -108,11 +108,30 @@ bool python_semantics_match(const unsigned char* p, const unsigned char* end) {
   return true;
 }
 
+// 64-bit seek/tell everywhere: plain fseek takes a long, which is 32-bit on
+// Windows/ILP32 and would truncate offsets past 2 GiB in exactly the multi-GB
+// corpora this loader targets.
+int seek64(std::FILE* f, int64_t pos, int whence) {
+#ifdef _WIN32
+  return _fseeki64(f, pos, whence);
+#else
+  return fseeko(f, static_cast<off_t>(pos), whence);
+#endif
+}
+
+int64_t tell64(std::FILE* f) {
+#ifdef _WIN32
+  return _ftelli64(f);
+#else
+  return static_cast<int64_t>(ftello(f));
+#endif
+}
+
 // Read [lo, hi) of the file, already line-aligned by the caller.
 std::vector<char> read_range(std::FILE* f, int64_t lo, int64_t hi) {
   std::vector<char> buf(static_cast<size_t>(hi - lo));
   if (!buf.empty()) {
-    std::fseek(f, static_cast<long>(lo), SEEK_SET);
+    seek64(f, lo, SEEK_SET);
     size_t got = std::fread(buf.data(), 1, buf.size(), f);
     buf.resize(got);
   }
@@ -127,7 +146,7 @@ std::vector<int64_t> line_aligned_cuts(std::FILE* f, int64_t size) {
   for (int i = 1; i < n; ++i) {
     int64_t target = size * i / n;
     if (target <= cuts.back()) continue;
-    std::fseek(f, static_cast<long>(target), SEEK_SET);
+    seek64(f, target, SEEK_SET);
     int c;
     int64_t pos = target;
     while ((c = std::fgetc(f)) != EOF) {
@@ -141,9 +160,9 @@ std::vector<int64_t> line_aligned_cuts(std::FILE* f, int64_t size) {
 }
 
 int64_t file_size(std::FILE* f) {
-  std::fseek(f, 0, SEEK_END);
-  int64_t n = std::ftell(f);
-  std::fseek(f, 0, SEEK_SET);
+  seek64(f, 0, SEEK_END);
+  int64_t n = tell64(f);
+  seek64(f, 0, SEEK_SET);
   return n;
 }
 

@@ -44,23 +44,30 @@ from glint_word2vec_tpu.train.trainer import Trainer
 
 rng = np.random.default_rng(0)
 words = [f"w{i}" for i in range(64)]
-sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
+if mode == "varlen":
+    # variable sentence lengths + odd sentence count: data segments exhaust at
+    # DIFFERENT rows, driving the iteration-barrier's held-offer/use-mask path
+    # (advisor r4 — fixed 12-token sentences never reach it)
+    lens = rng.integers(3, 40, 201)
+    sentences = [[words[j] for j in rng.integers(0, 64, L)] for L in lens]
+else:
+    sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
 vocab = build_vocab(sentences, min_count=1)
 cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
                      steps_per_dispatch=2, seed=7, subsample_ratio=0.0,
                      cbow=(mode == "cbow"),
                      device_pairgen=(mode in ("device", "device42", "dresume",
-                                              "eshrink", "egrow")),
+                                              "eshrink", "egrow", "varlen")),
                      shard_input=(mode in ("sharded", "resume", "cbow", "device",
                                            "device42", "dresume", "eshrink",
-                                           "egrow")),
+                                           "egrow", "varlen")),
                      # every 2-process test also exercises the SPMD divergence
                      # detector on its real feeds (must stay silent)
                      feed_consistency_check=True)
 # spans both processes: 8 global devices; device42 uses a 4-wide data axis so
 # each process owns TWO token segments (spp=2 in _fit_device_feed_sharded)
-plan = make_mesh(4, 2) if mode == "device42" else make_mesh(2, 4)
+plan = make_mesh(4, 2) if mode in ("device42", "varlen") else make_mesh(2, 4)
 encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 
 import jax.numpy as jnp
@@ -146,7 +153,7 @@ else:
     trainer = Trainer(cfg, vocab, plan=plan)
     assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
     assert trainer._feed_segments == (
-        2 if mode in ("sharded", "cbow", "device", "device42") else 1)
+        2 if mode in ("sharded", "cbow", "device", "device42", "varlen") else 1)
     trainer.fit(encoded)
     checksum = checksum_of(trainer)
     assert np.isfinite(checksum)
@@ -181,7 +188,7 @@ def _run_two(tmp_path, mode, marker="CHECKSUM"):
     return lines[0]
 
 
-def _parent_device_setup():
+def _parent_device_setup(varlen=False):
     """The worker script's corpus/config/mesh, rebuilt in the parent process
     (8 local virtual devices, single process) for cross-topology comparisons."""
     import jax
@@ -195,7 +202,12 @@ def _parent_device_setup():
 
     rng = np.random.default_rng(0)
     words = [f"w{i}" for i in range(64)]
-    sentences = [[words[j] for j in rng.integers(0, 64, 12)] for _ in range(200)]
+    if varlen:  # must mirror the worker script's "varlen" corpus exactly
+        lens = rng.integers(3, 40, 201)
+        sentences = [[words[j] for j in rng.integers(0, 64, L)] for L in lens]
+    else:
+        sentences = [[words[j] for j in rng.integers(0, 64, 12)]
+                     for _ in range(200)]
     vocab = build_vocab(sentences, min_count=1)
     cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                          num_iterations=2, window=3, negatives=3,
@@ -263,7 +275,8 @@ def test_two_process_cbow_sharded_feed(tmp_path):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("mode,mesh", [("device", (2, 4)), ("device42", (4, 2))])
+@pytest.mark.parametrize("mode,mesh", [("device", (2, 4)), ("device42", (4, 2)),
+                                       ("varlen", (4, 2))])
 def test_two_process_device_pairgen_bit_identity(tmp_path, mode, mesh):
     """device_pairgen across processes (round-4): each process packs token blocks
     for its own data segments only; the iteration-barrier allgather protocol
@@ -271,7 +284,12 @@ def test_two_process_device_pairgen_bit_identity(tmp_path, mode, mesh):
     byte-identical feed the single-process device-feed run sees — asserted here
     by matching the single-process run's checksum and exact pair count. The
     (4, 2) mesh gives each process TWO token segments (spp=2 — exercises the
-    per-own-segment assembly, positions, and hash-base slices spp=1 cannot)."""
+    per-own-segment assembly, positions, and hash-base slices spp=1 cannot).
+    The varlen case (advisor r4) uses variable sentence lengths (3-40 tokens,
+    odd sentence count), so the four data segments exhaust at different token
+    rows and the barrier's hard path — held offers, use-mask zeroing of
+    lagging/leading processes, per-process differing `real` counts — actually
+    executes; fixed-length corpora never reach it."""
     line = _run_two(tmp_path, mode)
     got = float(line.split()[1])
     got_pairs = float(line.split()[5])
@@ -279,7 +297,8 @@ def test_two_process_device_pairgen_bit_identity(tmp_path, mode, mesh):
     from glint_word2vec_tpu.parallel.mesh import make_mesh
     from glint_word2vec_tpu.train.trainer import Trainer
 
-    vocab, encoded, cfg, _, checksum = _parent_device_setup()
+    vocab, encoded, cfg, _, checksum = _parent_device_setup(
+        varlen=(mode == "varlen"))
     trainer = Trainer(cfg, vocab, plan=make_mesh(*mesh))
     trainer.fit(encoded)
     want = checksum(trainer)
