@@ -51,6 +51,9 @@ K = 16             # steps per dispatch chunk (step rows)
 B_MAIN = 65536
 E2E_K = 32
 E2E_POOL = 512     # EVAL_RUNS-validated at 60M words (load 640, bf16+f32)
+E2E_SUBSAMPLE = 1e-4  # the stability-evidence subsample ratio: the SAME key at
+                      # 1e-3 is measured-divergent (EVAL round-4 addendum), so
+                      # the headline gate matches on it too
 CPU_STEPS = 3
 PEAK_FLOPS = 197e12  # v5e bf16 peak / chip
 V_SCALE = 1_000_000
@@ -95,16 +98,23 @@ def load_eval_stability(repo_root: str) -> list:
     return rows
 
 
-def eval_stable(rows: list, batch: int, pool: int, param_dtype: str) -> bool:
+def eval_stable(rows: list, batch: int, pool: int, param_dtype: str,
+                logits_dtype: str, subsample_ratio: float) -> bool:
     """True iff tools/eval_quality.py trained this geometry on >=60M words without
     divergence. The bench REFUSES to headline configs without this evidence.
-    Rescored rows don't count: their config metadata comes from CLI flags,
-    unverified against the saved model they re-scored."""
+    The match key is the FULL stability-relevant config — (batch, pool,
+    param_dtype, logits_dtype, subsample_ratio) — because EVAL_RUNS holds both a
+    stable (64k, 512, bf16, subsample 1e-4) and a divergent (same, 1e-3) row:
+    matching on the first three alone would bless the measured-NaN config
+    (VERDICT r4 weak #3). Rescored rows don't count: their config metadata comes
+    from CLI flags, unverified against the saved model they re-scored."""
     for r in rows:
         if (not r.get("rescored")
                 and r.get("pairs_per_batch") == batch
                 and r.get("negative_pool") == pool
                 and r.get("param_dtype") == param_dtype
+                and r.get("logits_dtype") == logits_dtype
+                and r.get("subsample_ratio") == subsample_ratio
                 and r.get("corpus_words", 0) >= 60_000_000
                 and not r.get("diverged")):
             return True
@@ -279,7 +289,7 @@ def bench_e2e(device_pairgen: bool, param_dtype: str, logits_dtype: str,
     cfg = Word2VecConfig(
         vector_size=D, min_count=5, pairs_per_batch=B_MAIN, num_iterations=1,
         window=5, negatives=NEG, negative_pool=pool, steps_per_dispatch=E2E_K,
-        seed=1, subsample_ratio=1e-4, device_pairgen=device_pairgen,
+        seed=1, subsample_ratio=E2E_SUBSAMPLE, device_pairgen=device_pairgen,
         param_dtype=param_dtype, compute_dtype=param_dtype,
         logits_dtype=logits_dtype)
     trainer = Trainer(cfg, vocab)
@@ -427,8 +437,11 @@ def main() -> None:
     rows["bf16_p512"] = bench_step(counts, B_MAIN, E2E_POOL, dtype="bfloat16",
                                    param_dtype="bfloat16",
                                    logits_dtype="bfloat16")
+    # logits bf16 on the p1024 row too: that is the config EVAL_RUNS holds
+    # stability evidence for (the gate matches on logits_dtype now)
     rows["bf16_p1024"] = bench_step(counts, B_MAIN, 1024, dtype="bfloat16",
-                                    param_dtype="bfloat16")
+                                    param_dtype="bfloat16",
+                                    logits_dtype="bfloat16")
     cbow_eps = None
     try:
         cbow_eps, _ = bench_cbow_step(counts, B_MAIN, E2E_POOL)
@@ -456,12 +469,13 @@ def main() -> None:
 
     # headline: fastest STEP row whose geometry has >=60M-word non-divergent
     # EVAL evidence (the r3 failure mode: headlining a config that NaNs)
-    dtype_of = {"f32_p512": ("float32", E2E_POOL),
-                "bf16_p512": ("bfloat16", E2E_POOL),
-                "bf16_p1024": ("bfloat16", 1024)}
+    dtype_of = {"f32_p512": ("float32", E2E_POOL, "float32"),
+                "bf16_p512": ("bfloat16", E2E_POOL, "bfloat16"),
+                "bf16_p1024": ("bfloat16", 1024, "bfloat16")}
     stable_keys = [k for k in rows
                    if eval_stable(eval_rows, B_MAIN, dtype_of[k][1],
-                                  dtype_of[k][0])]
+                                  dtype_of[k][0], dtype_of[k][2],
+                                  E2E_SUBSAMPLE)]
     if not stable_keys:
         log("WARNING: no step row has 60M-word EVAL evidence; refusing a step "
             "headline, publishing the e2e number instead")

@@ -41,8 +41,17 @@ class Word2VecConfig:
     batch_size: int = 50            # batchSize (mllib:74) — reference centers-per-minibatch;
                                     # kept for decay/compat; device batching uses pairs_per_batch
     negatives: int = 5              # n (mllib:75)
-    subsample_ratio: float = 1e-3   # subsampleRatio (mllib:77,190-194). 0 disables.
-                                    # Default 1e-3 (word2vec.c's/gensim's default):
+    subsample_ratio: float = -1.0   # subsampleRatio (mllib:77,190-194). 0 disables.
+                                    # -1 (default) = AUTO: resolves to 1e-3 at
+                                    # construction, and the Trainer may LOWER it
+                                    # further when the corpus + batch geometry would
+                                    # exceed the measured duplicate-overload
+                                    # divergence boundary (expected top-word
+                                    # duplicates per batch > 300 trains to NaN —
+                                    # EVAL.md round-4 addendum). An explicit value is
+                                    # never silently changed: explicit unstable
+                                    # configs are refused unless allow_unstable=True.
+                                    # Why 1e-3 (word2vec.c's/gensim's default):
                                     # bounds EVAL.md's duplicate-overload channel — a
                                     # frequent word's summed scatter updates in one
                                     # large batch diverge with subsampling OFF — while
@@ -103,6 +112,14 @@ class Word2VecConfig:
                                     # integration suite's settings)
     sigmoid_mode: str = "exact"     # "exact" = jax.nn.sigmoid; "clipped" mirrors the reference
                                     # LUT clipping at |f| > 6 (mllib:246-248,292-302)
+    allow_unstable: bool = False    # override the construction-time REFUSAL of configs
+                                    # inside a measured divergence region (today: the
+                                    # duplicate-overload channel — explicit
+                                    # subsample_ratio whose expected top-word
+                                    # duplicates per batch exceed 300, the boundary
+                                    # EVAL measured training to NaN at 60M words).
+                                    # With the override the trainer only warns, for
+                                    # boundary research and short runs
     duplicate_scaling: bool = False  # opt-in stabilizer: average (not sum) a row's updates
                                      # over its in-batch multiplicity. Off by default —
                                      # textbook word2vec semantics; realistic vocabs have
@@ -240,9 +257,15 @@ class Word2VecConfig:
             raise ValueError(f"batch_size must be positive but got {self.batch_size}")
         if self.negatives <= 0:
             raise ValueError(f"negatives must be positive but got {self.negatives}")
+        # remembered so the Trainer may auto-lower an AUTO ratio into the measured
+        # stability region (explicit values are refused instead, see trainer.py)
+        self._auto_subsample = self.subsample_ratio == -1.0
+        if self._auto_subsample:
+            self.subsample_ratio = 1e-3
         if not (0 <= self.subsample_ratio <= 1):
             raise ValueError(
-                f"subsample_ratio must be in [0, 1] but got {self.subsample_ratio}")
+                f"subsample_ratio must be in [0, 1] (or -1 for auto) "
+                f"but got {self.subsample_ratio}")
         if self.unigram_table_size <= 0:
             raise ValueError(
                 f"unigram_table_size must be positive but got {self.unigram_table_size}")
@@ -296,10 +319,21 @@ class Word2VecConfig:
             # the pool was auto-derived from the OLD batch geometry — re-derive it
             # for the new one instead of freezing a now-undersized pool
             kwargs["negative_pool"] = -1
+        if (getattr(self, "_auto_subsample", False)
+                and "subsample_ratio" not in kwargs):
+            # keep auto-ness: the Trainer's stability auto-lowering must still
+            # apply to the derived config (a frozen 1e-3 would read as explicit)
+            kwargs["subsample_ratio"] = -1.0
         return dataclasses.replace(self, **kwargs)
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        if getattr(self, "_auto_subsample", False):
+            # preserve AUTO-ness across serialization (symmetric with replace()):
+            # a pre-resolution config shipped to a worker must auto-lower there,
+            # not read as an explicitly chosen 1e-3 and be refused
+            d["subsample_ratio"] = -1.0
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Word2VecConfig":

@@ -199,6 +199,12 @@ class ServerSideGlintWord2Vec:
             batch_size=self._batch_size,
             negatives=self._n,
             subsample_ratio=self._subsample_ratio,
+            # drop-in parity: the reference runs any of these configs (its async
+            # 50-pair minibatches never face the synchronous duplicate-overload
+            # channel), so the compat surface must not hard-refuse them — keep
+            # the round-4 warn-only behavior; the construction-time warning
+            # still names the danger and the fix
+            allow_unstable=True,
             # the reference samples n negatives per pair server-side (G3,
             # mllib:419-421) — pin the exact per-pair path rather than inheriting
             # the TPU-native config's auto-scaled shared pool

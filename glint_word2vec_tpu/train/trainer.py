@@ -50,6 +50,18 @@ from glint_word2vec_tpu.train.checkpoint import TrainState, save_model
 logger = logging.getLogger("glint_word2vec_tpu")
 
 
+def _pairs_per_kept_token(window: int) -> float:
+    """Analytic E[pairs emitted per kept token] under the reference's legacy
+    asymmetric window (mllib:381-390): span b = nextInt(window) to the left and
+    max(b − 1, 0) to the right. Ignores sentence-boundary clipping, so it
+    OVERESTIMATES slightly — every caller (tokens-per-step sizing, heartbeat
+    pair estimates, the duplicate-load stability bound) wants the conservative
+    direction. Floored at 1e-3 so window=1 (zero expected pairs) never divides
+    by zero."""
+    b = np.arange(window, dtype=np.float64)
+    return max(float(b.mean() + np.clip(b - 1, 0, None).mean()), 1e-3)
+
+
 @dataclass
 class HeartbeatRecord:
     words: int
@@ -270,10 +282,14 @@ class Trainer:
                     "device_pairgen with window=1 emits no pairs at all under the "
                     "reference's legacy asymmetric window (b = nextInt(1) = 0 "
                     "always, and the right bound is exclusive) — use window >= 2")
+            # resolve the duplicate-overload channel BEFORE deriving keep
+            # probabilities (an AUTO subsample may be lowered here); runs after
+            # the config-shape validations above so specific errors fire first
+            self._resolve_duplicate_channel()
             from glint_word2vec_tpu.data.pipeline import keep_probabilities
             keep = keep_probabilities(
                 vocab.counts, vocab.train_words_count,
-                config.subsample_ratio).astype(np.float32)
+                self.config.subsample_ratio).astype(np.float32)
             self._keep_host = keep
             kp = np.zeros(self.padded_vocab, np.float32)
             kp[:vocab.size] = keep
@@ -291,6 +307,11 @@ class Trainer:
             self._chunk_shardings = {"tokens": plan.tokens_stacked,
                                      "starts": plan.tokens_stacked,
                                      "obase": plan.tokens_stacked}
+        # bound the duplicate-overload divergence channel (EVAL.md measured
+        # boundary): auto-lower an AUTO subsample_ratio or refuse an explicit
+        # unstable one. Idempotent — the device-feed path already resolved it
+        # before deriving its keep probabilities above.
+        self._resolve_duplicate_channel()
         # resume continues the (seed, counter) PRNG lattice where the checkpoint left
         # off — restarting at 0 would redraw the run's opening negative-sample stream
         self.global_step = self.state.global_step
@@ -308,10 +329,8 @@ class Trainer:
         actual pair count concentrates tightly (std ≈ √T window-draw noise, <1% of B),
         so overflow drops stay rare; the trainer counts and reports them."""
         cfg = self.config
-        b = np.arange(cfg.window, dtype=np.float64)  # nextInt(window) draws
-        rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()  # legacy window
         # the packer subsamples host-side, so shipped tokens are KEPT tokens
-        rate = max(rate_per_kept, 1e-3)
+        rate = _pairs_per_kept_token(cfg.window)
         T = int(np.ceil(0.93 * cfg.pairs_per_batch / self.plan.num_data / rate))
         return max(T, 64)
 
@@ -355,11 +374,7 @@ class Trainer:
                 "negative_pool with the batch (e.g. %d) to keep the load ~1300 "
                 "(EVAL.md)", pool_load,
                 max(64, int(cfg.pairs_per_batch * cfg.negatives / 1300)))
-        from glint_word2vec_tpu.data.pipeline import keep_probabilities
-        keep = keep_probabilities(
-            self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
-        eff = np.asarray(self.vocab.counts, np.float64) * keep
-        dup_load = float(eff.max() / max(eff.sum(), 1.0)) * cfg.pairs_per_batch
+        dup_load = self._duplicate_load(cfg.subsample_ratio)
         if dup_load > 300:
             logger.warning(
                 "expected duplicates of the most frequent word per %d-pair batch "
@@ -377,6 +392,85 @@ class Trainer:
                 "rows over long runs (measured NaN at 60M words, EVAL.md) — for "
                 "long runs grow negative_pool (load <= ~600) or shrink "
                 "pairs_per_batch", pool_load, dup_load)
+
+    def _duplicate_load(self, subsample_ratio: float) -> float:
+        """Expected in-batch duplicates of the most frequent word under the given
+        subsample ratio — the divergence channel's driving quantity (EVAL.md)."""
+        from glint_word2vec_tpu.data.pipeline import keep_probabilities
+        cfg = self.config
+        keep = keep_probabilities(
+            self.vocab.counts, self.vocab.train_words_count, subsample_ratio)
+        eff = np.asarray(self.vocab.counts, np.float64) * keep
+        s = float(eff.sum())
+        if s <= 0.0:
+            return 0.0
+        # a batch cannot hold more REAL pairs than one epoch supplies — on
+        # corpora smaller than pairs_per_batch the batch is mostly mask padding
+        real_pairs = min(float(cfg.pairs_per_batch),
+                         s * _pairs_per_kept_token(cfg.window))
+        # NB: a max(s, 1.0) floor on the denominator would deflate the SHARE
+        # whenever strong subsampling drives the total effective count below 1
+        # (the share is scale-free; only s == 0 needs guarding)
+        return float(eff.max()) / s * real_pairs
+
+    # the measured NaN boundary is ~300 expected top-word duplicates per batch
+    # (EVAL.md round-4 addendum: 336 trains to NaN at 60M words); auto-lowering
+    # targets 250 for margin under the run-to-run corpus variation
+    _DUP_LOAD_REFUSE = 300.0
+    _DUP_LOAD_TARGET = 250.0
+
+    def _resolve_duplicate_channel(self) -> None:
+        """Bound the duplicate-overload channel at construction, like the pool
+        channel's auto-sizing (config.py): an AUTO subsample_ratio is lowered
+        until the expected top-word duplicates per batch fall under the measured
+        divergence boundary; an explicit ratio past the boundary is REFUSED
+        (config.allow_unstable overrides to the old warn-only behavior). The
+        reference never faces this channel — its async 50-pair minibatches
+        interleave a frequent word's updates instead of summing them
+        (mllib:417-429)."""
+        cfg = self.config
+        if cfg.duplicate_scaling:
+            return  # mean-update semantics bound the channel by construction
+        load = self._duplicate_load(cfg.subsample_ratio)
+        if load <= self._DUP_LOAD_REFUSE:
+            return
+        if not getattr(cfg, "_auto_subsample", False):
+            if cfg.allow_unstable:
+                return  # _stability_warnings still names the danger at fit time
+            raise ValueError(
+                f"expected duplicates of the most frequent word per "
+                f"{cfg.pairs_per_batch}-pair batch = {load:.0f} exceed the "
+                f"measured divergence boundary (~{self._DUP_LOAD_REFUSE:.0f}: "
+                f"summed scatter updates this dense trained to NaN at 60M words, "
+                f"EVAL.md) with subsample_ratio={cfg.subsample_ratio}. Lower "
+                f"subsample_ratio (~1e-4), set duplicate_scaling=True, shrink "
+                f"pairs_per_batch, or set allow_unstable=True to proceed anyway")
+        # AUTO ratio: binary-search the largest ratio meeting the target load
+        # (smaller ratio = stronger subsampling = fewer top-word duplicates)
+        lo, hi = 1e-12, cfg.subsample_ratio
+        if self._duplicate_load(lo) > self._DUP_LOAD_TARGET:
+            if cfg.allow_unstable:
+                return  # _stability_warnings still names the danger at fit time
+            raise ValueError(
+                f"the duplicate-overload channel cannot be bounded by subsampling "
+                f"alone on this corpus (top-word duplicates per "
+                f"{cfg.pairs_per_batch}-pair batch stay > "
+                f"{self._DUP_LOAD_TARGET:.0f} at any ratio — tiny vocabulary?); "
+                f"set duplicate_scaling=True, shrink pairs_per_batch, or set "
+                f"allow_unstable=True for a short toy run")
+        for _ in range(60):
+            mid = (lo * hi) ** 0.5  # geometric: the scale spans many decades
+            if self._duplicate_load(mid) > self._DUP_LOAD_TARGET:
+                hi = mid
+            else:
+                lo = mid
+        logger.warning(
+            "auto subsample_ratio lowered 1e-3 -> %.3g: at pairs_per_batch=%d "
+            "this corpus's most frequent word would otherwise see ~%.0f summed "
+            "duplicate updates per batch, past the measured divergence boundary "
+            "(~%.0f, EVAL.md); pass subsample_ratio explicitly to pin a value",
+            lo, cfg.pairs_per_batch, load, self._DUP_LOAD_REFUSE)
+        self.config = cfg.replace(subsample_ratio=lo)
 
     def _build_step(self) -> Callable:
         cfg = self.config
@@ -935,8 +1029,7 @@ class Trainer:
                       if not (self.state.finished or seg_state) else 0)
         # analytic pairs/step estimate — heartbeat display only; exact totals come
         # back from the device (see end of method)
-        b = np.arange(cfg.window, dtype=np.float64)
-        rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()
+        rate_per_kept = _pairs_per_kept_token(cfg.window)
 
         def chunk_stream():
             for k in range(start_iter, cfg.num_iterations + 1):
@@ -1237,8 +1330,7 @@ class Trainer:
         seg_state = self._device_seg_resume_state()[pid * spp:(pid + 1) * spp]
         start_iter = min(it for it, _ in seg_state)
 
-        b = np.arange(cfg.window, dtype=np.float64)
-        rate_per_kept = b.mean() + np.clip(b - 1, 0, None).mean()
+        rate_per_kept = _pairs_per_kept_token(cfg.window)
 
         def local_stream():
             """This process's chunks: K step-rows of spp [T]-token segment blocks

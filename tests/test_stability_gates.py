@@ -1,0 +1,161 @@
+"""Construction-time stability machinery (VERDICT r4 item 3).
+
+Two measured divergence channels exist for large synchronous batches (EVAL.md):
+the pool channel (auto-bounded by config's pool sizing since round 3) and the
+duplicate-overload channel, which round 4 only WARNED about — the default
+subsample 1e-3 at B=64k is a config the EVAL suite trained to NaN at 60M words.
+These tests pin the round-5 behavior: an AUTO subsample ratio is lowered under
+the measured boundary, an explicit unstable ratio is refused (with an
+allow_unstable override), and the bench's headline gate matches the FULL
+stability key (incl. subsample_ratio and logits_dtype) so it can no longer
+bless the measured-NaN config.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.vocab import Vocabulary
+from glint_word2vec_tpu.train.trainer import Trainer
+
+
+def _zipf_vocab(v: int = 20_000) -> Vocabulary:
+    """A natural-language-shaped vocabulary: Zipf 1.05 counts, top word ~5% of
+    tokens — the regime where B=64k batches overload the top word's row."""
+    counts = np.maximum(1e8 / (np.arange(v) + 10.0) ** 1.05, 5.0).astype(np.int64)
+    words = [f"w{i}" for i in range(v)]
+    return Vocabulary.from_words_and_counts(words, counts)
+
+
+BIG = dict(vector_size=64, pairs_per_batch=65536, min_count=5, seed=1)
+
+
+class TestDuplicateChannelResolution:
+    def test_explicit_unstable_ratio_refused(self):
+        # the EVAL-divergent config: B=64k, subsample 1e-3, natural Zipf corpus
+        vocab = _zipf_vocab()
+        cfg = Word2VecConfig(subsample_ratio=1e-3, **BIG)
+        with pytest.raises(ValueError, match="divergence boundary"):
+            Trainer(cfg, vocab)
+
+    def test_allow_unstable_overrides_refusal(self):
+        vocab = _zipf_vocab()
+        cfg = Word2VecConfig(subsample_ratio=1e-3, allow_unstable=True, **BIG)
+        t = Trainer(cfg, vocab)  # constructs; fit-time warning still applies
+        assert t.config.subsample_ratio == 1e-3  # never silently changed
+
+    def test_auto_ratio_lowered_under_boundary(self):
+        vocab = _zipf_vocab()
+        cfg = Word2VecConfig(**BIG)  # subsample_ratio left AUTO
+        t = Trainer(cfg, vocab)
+        assert t.config.subsample_ratio < 1e-3
+        assert t._duplicate_load(t.config.subsample_ratio) <= 300
+        # close to the target, not needlessly aggressive (coverage costs quality)
+        assert t._duplicate_load(t.config.subsample_ratio) > 150
+
+    def test_stable_geometry_untouched(self):
+        # small batches never hit the boundary: auto ratio stays at 1e-3
+        vocab = _zipf_vocab()
+        cfg = Word2VecConfig(vector_size=16, pairs_per_batch=256, min_count=5)
+        t = Trainer(cfg, vocab)
+        assert t.config.subsample_ratio == 1e-3
+
+    def test_duplicate_scaling_bounds_by_construction(self):
+        vocab = _zipf_vocab()
+        cfg = Word2VecConfig(subsample_ratio=1e-3, duplicate_scaling=True, **BIG)
+        t = Trainer(cfg, vocab)  # mean-update semantics: no refusal
+        assert t.config.subsample_ratio == 1e-3
+
+    def test_unboundable_corpus_refused_with_guidance(self):
+        # tiny vocab on a LARGE corpus: the top word is ~1/3 of any full batch
+        # no matter the subsampling (on a corpus smaller than a batch the
+        # epoch-pair cap bounds the load instead, so big counts are needed to
+        # keep batches full at strong subsampling) — must refuse with the
+        # duplicate_scaling suggestion
+        counts = np.array([10**9, 9 * 10**8, 8 * 10**8], np.int64)
+        vocab = Vocabulary.from_words_and_counts(["a", "b", "c"], counts)
+        cfg = Word2VecConfig(**BIG)
+        with pytest.raises(ValueError, match="duplicate_scaling"):
+            Trainer(cfg, vocab)
+
+    def test_resolved_config_round_trips(self):
+        # a checkpoint stores the RESOLVED ratio; reconstructing from it must
+        # not refuse (it is inside the boundary) nor re-lower it
+        vocab = _zipf_vocab()
+        t = Trainer(Word2VecConfig(**BIG), vocab)
+        resolved = t.config.subsample_ratio
+        cfg2 = Word2VecConfig.from_dict(t.config.to_dict())
+        t2 = Trainer(cfg2, vocab)
+        assert t2.config.subsample_ratio == resolved
+
+    def test_to_dict_preserves_auto_before_resolution(self):
+        # a pre-resolution config shipped to a worker must stay AUTO there,
+        # not read as an explicitly chosen 1e-3 and get refused
+        cfg = Word2VecConfig(**BIG)
+        cfg2 = Word2VecConfig.from_dict(cfg.to_dict())
+        assert cfg2._auto_subsample
+        t = Trainer(cfg2, _zipf_vocab())  # auto-lowers instead of refusing
+        assert t.config.subsample_ratio < 1e-3
+
+    def test_compat_layer_keeps_drop_in_behavior(self):
+        # the compat surface mirrors the reference, which runs ANY of these
+        # configs (async minibatches never face the synchronous duplicate
+        # channel) — construction must warn, not refuse, even with its pinned
+        # subsample_ratio=0.0 on a natural-language-shaped corpus
+        from glint_word2vec_tpu.models.compat import ServerSideGlintWord2Vec
+        cfg = (ServerSideGlintWord2Vec().setVectorSize(8).setMinCount(1)
+               .to_config())
+        assert cfg.allow_unstable and cfg.subsample_ratio == 0.0
+        Trainer(cfg, _zipf_vocab())  # would refuse without the override
+
+    def test_replace_preserves_auto(self):
+        cfg = Word2VecConfig(**BIG)
+        assert cfg._auto_subsample
+        cfg2 = cfg.replace(pairs_per_batch=1024)
+        assert cfg2._auto_subsample and cfg2.subsample_ratio == 1e-3
+        cfg3 = cfg.replace(subsample_ratio=5e-4)
+        assert not cfg3._auto_subsample
+
+
+class TestBenchHeadlineGate:
+    """bench.eval_stable must match the full stability key (VERDICT r4 #3a)."""
+
+    STABLE = {"pairs_per_batch": 65536, "negative_pool": 512,
+              "param_dtype": "bfloat16", "logits_dtype": "bfloat16",
+              "subsample_ratio": 1e-4, "corpus_words": 60_000_000}
+    DIVERGED = {**STABLE, "subsample_ratio": 1e-3, "diverged": True}
+
+    def _gate(self):
+        import bench
+        return bench.eval_stable
+
+    def test_full_key_match_passes(self):
+        gate = self._gate()
+        assert gate([self.STABLE], 65536, 512, "bfloat16", "bfloat16", 1e-4)
+
+    def test_subsample_mismatch_refused(self):
+        # the r4 hole: EVAL holds a stable 1e-4 row AND a divergent 1e-3 row
+        # with the same (batch, pool, dtype) key — the 1e-3 headline must NOT
+        # be blessed by the 1e-4 evidence
+        gate = self._gate()
+        assert not gate([self.STABLE, self.DIVERGED],
+                        65536, 512, "bfloat16", "bfloat16", 1e-3)
+
+    def test_logits_dtype_mismatch_refused(self):
+        gate = self._gate()
+        assert not gate([self.STABLE], 65536, 512, "bfloat16", "float32", 1e-4)
+
+    def test_diverged_and_rescored_rows_never_count(self):
+        gate = self._gate()
+        assert not gate([self.DIVERGED], 65536, 512, "bfloat16", "bfloat16", 1e-3)
+        assert not gate([{**self.STABLE, "rescored": True}],
+                        65536, 512, "bfloat16", "bfloat16", 1e-4)
+
+    def test_short_run_insufficient(self):
+        gate = self._gate()
+        assert not gate([{**self.STABLE, "corpus_words": 17_000_000}],
+                        65536, 512, "bfloat16", "bfloat16", 1e-4)
