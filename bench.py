@@ -146,9 +146,14 @@ def bench_step(counts, b: int, pool: int, dtype: str = "float32",
 
         def body(p, inp):
             batch, ng = inp
+            # with_metrics=False: the production steady state — the trainer
+            # dispatches the metrics-elided twin for every chunk without a
+            # heartbeat (~6 of 7 dispatches at the default cadence); the fetch
+            # below pulls from the PARAMS carry, which depends on every update
             new_p, m = sgns_step_shared_core(
                 p, batch["centers"], batch["contexts"], batch["mask"],
-                ng, jnp.float32(0.025), NEG, "exact", cdt, False, ldt)
+                ng, jnp.float32(0.025), NEG, "exact", cdt, False, ldt,
+                with_metrics=False)
             return new_p, m.loss
 
         return jax.lax.scan(body, params, (batches, negs))
@@ -174,7 +179,9 @@ def bench_step(counts, b: int, pool: int, dtype: str = "float32",
             make_carry=lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
             args_for_iter=lambda i: (all_batches[i % 8], np.int32(100 + i)),
             n_lo=2, n_hi=8,
-            fetch=lambda c, out: out[-1])
+            # the loss channel is elided (constant 0) — the barrier fetch MUST
+            # depend on the updated params or the chain can be elided
+            fetch=lambda c, out: c.syn0[0, 0].astype(jnp.float32))
         ts.append(spc / K)
     spp = float(np.median(ts))
     ms = spp * 1e3

@@ -326,12 +326,15 @@ class Word2VecConfig:
             kwargs["subsample_ratio"] = -1.0
         return dataclasses.replace(self, **kwargs)
 
-    def to_dict(self) -> dict:
+    def to_dict(self, auto_markers: bool = True) -> dict:
         d = dataclasses.asdict(self)
-        if getattr(self, "_auto_subsample", False):
+        if auto_markers and getattr(self, "_auto_subsample", False):
             # preserve AUTO-ness across serialization (symmetric with replace()):
             # a pre-resolution config shipped to a worker must auto-lower there,
-            # not read as an explicitly chosen 1e-3 and be refused
+            # not read as an explicitly chosen 1e-3 and be refused.
+            # auto_markers=False (checkpoints) stores the RESOLVED value instead:
+            # a trained model's metadata must pin the semantics it trained with,
+            # and format-version-1 readers reject a -1.0 sentinel
             d["subsample_ratio"] = -1.0
         return d
 

@@ -251,6 +251,7 @@ def sgns_step_shared_core(
     compute_dtype: jnp.dtype = jnp.float32,
     duplicate_scaling: bool = False,
     logits_dtype: jnp.dtype = jnp.float32,
+    with_metrics: bool = True,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """:func:`sgns_step_shared` with the pool supplied by the caller (see
     :func:`sgns_step_core` for why sampling lives outside the jitted scan).
@@ -271,7 +272,13 @@ def sgns_step_shared_core(
     [B, P] array (~268 MB at B=64k/P=1024) and becomes a measurable slice of the
     step (PERF.md §4); ``bfloat16`` keeps it in half precision — gradient
     coefficients are O(α·n/P) and tolerate ~0.4% relative noise. Loss/metric
-    reductions still accumulate in f32."""
+    reductions still accumulate in f32.
+
+    ``with_metrics=False`` skips the loss/mean_f_pos side-channel (the negative
+    loss term is an extra full [B, P] pass — measured ~0.3 ms at B=64k/P=512
+    bf16, PERF.md §4); ``pairs`` stays exact (it is load-bearing for the
+    trainer's pair accounting). The trainer dispatches this variant for chunks
+    no heartbeat will sample."""
     syn0, syn1 = params
     P = negatives.shape[0]
     V = syn0.shape[0]
@@ -321,14 +328,18 @@ def sgns_step_shared_core(
     new_syn1 = syn1.at[contexts].add(d_pos.astype(dtype))
     new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
 
-    denom = jnp.maximum(mask.sum(), 1.0)
-    loss = (-_log_sigmoid(f_pos) * mask
-            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1,
-                      dtype=jnp.float32)
-            * (num_negatives / P)).sum() / denom
+    if with_metrics:
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (-_log_sigmoid(f_pos) * mask
+                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1,
+                          dtype=jnp.float32)
+                * (num_negatives / P)).sum() / denom
+        mean_f_pos = (f_pos * mask).sum() / denom
+    else:
+        loss = mean_f_pos = jnp.float32(0.0)
     metrics = StepMetrics(
         loss=loss,
-        mean_f_pos=(f_pos * mask).sum() / denom,
+        mean_f_pos=mean_f_pos,
         pairs=mask.sum(),
     )
     return EmbeddingPair(new_syn0, new_syn1), metrics

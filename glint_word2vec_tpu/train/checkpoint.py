@@ -155,7 +155,7 @@ def save_model(
             "framework": "glint_word2vec_tpu",
             "vocab_size": int(syn0.shape[0]),
             "vector_size": int(syn0.shape[1]),
-            "config": config.to_dict(),
+            "config": config.to_dict(auto_markers=False),
             "train_state": (train_state or TrainState(finished=True)).to_dict(),
         }
         with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
@@ -266,7 +266,7 @@ def save_model_sharded(
                                    else syn0.shape[1]),
                 "padded_vocab": int(syn0.shape[0]),
                 "padded_dim": int(syn0.shape[1]),
-                "config": config.to_dict(),
+                "config": config.to_dict(auto_markers=False),
                 "train_state": (train_state or TrainState(finished=True)).to_dict(),
             }
             with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:

@@ -318,6 +318,13 @@ class Trainer:
         self.pairs_trained = 0.0  # real (unmasked) pairs dispatched over this run
         self.heartbeats: List[HeartbeatRecord] = []
         self._step_fn = self._build_step()
+        # fast twin (metrics elided) for the shared-pool skip-gram path only:
+        # the one path whose loss side-channel is a measured slice of the step
+        self._step_fn_fast = (
+            self._build_step(with_metrics=False)
+            if (self.config.negative_pool > 0 and not self.config.cbow
+                and not self.config.use_pallas)
+            else self._step_fn)
 
     # -- setup -------------------------------------------------------------------------
 
@@ -472,11 +479,18 @@ class Trainer:
             lo, cfg.pairs_per_batch, load, self._DUP_LOAD_REFUSE)
         self.config = cfg.replace(subsample_ratio=lo)
 
-    def _build_step(self) -> Callable:
+    def _build_step(self, with_metrics: bool = True) -> Callable:
+        """Build the jitted chunk function. ``with_metrics=False`` builds the
+        fast twin of the shared-pool skip-gram path: loss/mean_f_pos elided
+        (one fewer full [B, P] pass, ~0.3 ms at the headline shape — PERF.md
+        §4), pairs kept exact. The trainer dispatches the fast twin for chunks
+        no heartbeat will sample (see _dispatch_step_fn); both twins share the
+        same update math, so the trained parameters are bit-identical."""
         cfg = self.config
+        quiet = not with_metrics  # the full build already warned at __init__
         compute_dtype = jnp.dtype(cfg.compute_dtype)
         logits_dtype = jnp.dtype(cfg.logits_dtype)
-        if logits_dtype != jnp.float32 and not (
+        if not quiet and logits_dtype != jnp.float32 and not (
                 cfg.negative_pool > 0 and not cfg.use_pallas
                 and not (cfg.cbow and cfg.duplicate_scaling)):
             logger.warning(
@@ -519,13 +533,14 @@ class Trainer:
             pool = cfg.negative_pool if cfg.negative_pool > 0 else 64
             neg_shape = lambda K, B: (K, pool)  # noqa: E731
         elif cfg.negative_pool > 0 and not cfg.cbow:
-            self._stability_warnings()
+            if not quiet:
+                self._stability_warnings()
 
             def inner(params, batch, negatives, alpha):
                 return sgns_step_shared_core(
                     params, batch["centers"], batch["contexts"], batch["mask"],
                     negatives, alpha, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
-                    cfg.duplicate_scaling, logits_dtype)
+                    cfg.duplicate_scaling, logits_dtype, with_metrics)
 
             neg_shape = shared_pool_shape
         elif cfg.cbow and cfg.negative_pool > 0 and not cfg.duplicate_scaling:
@@ -663,6 +678,18 @@ class Trainer:
             return jax.lax.scan(body, params, (arrays, alphas, reals, negatives))
 
         return jax.jit(chunk, donate_argnums=(0,))
+
+    def _dispatch_step_fn(self, max_steps: int) -> Callable:
+        """The step function for the NEXT dispatch: the fast (metrics-elided)
+        twin unless a heartbeat may sample this chunk's metrics. ``max_steps``
+        is an upper bound on the real steps the chunk advances, so the
+        prediction can only err toward the full-metrics twin (a heartbeat never
+        lands on an elided chunk)."""
+        if (self._step_fn_fast is self._step_fn
+                or self.global_step + max_steps - self._last_log_step
+                >= self.config.heartbeat_every_steps):
+            return self._step_fn
+        return self._step_fn_fast
 
     # -- training ----------------------------------------------------------------------
 
@@ -820,7 +847,7 @@ class Trainer:
                 stacked = (chunk["arrays"] if staged else
                            put_global(self._chunk_shardings, chunk["arrays"]))
                 real = chunk["real"]
-                self.params, metrics = self._step_fn(
+                self.params, metrics = self._dispatch_step_fn(real)(
                     self.params, stacked, chunk["meta"],
                     np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias)
@@ -1144,7 +1171,7 @@ class Trainer:
                 stacked = (chunk["arrays"] if staged else
                            put_global(self._chunk_shardings, chunk["arrays"]))
                 real = chunk["real"]
-                self.params, (metrics, dropped) = self._step_fn(
+                self.params, (metrics, dropped) = self._dispatch_step_fn(real)(
                     self.params, stacked, chunk["meta"],
                     np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias,
@@ -1487,7 +1514,7 @@ class Trainer:
                     self._assert_feed_consistent(
                         dict(arrays, sub=sub_bases, win=win_bases), meta)
                 stacked = put_global(self._chunk_shardings, arrays)
-                self.params, (metrics, dropped) = self._step_fn(
+                self.params, (metrics, dropped) = self._dispatch_step_fn(real)(
                     self.params, stacked, meta,
                     np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias,
@@ -1850,7 +1877,7 @@ class Trainer:
                 if cfg.feed_consistency_check:
                     self._assert_feed_consistent(feed, meta)
                 stacked = put_global(self._chunk_shardings, feed)
-                self.params, metrics = self._step_fn(
+                self.params, metrics = self._dispatch_step_fn(real)(
                     self.params, stacked, meta,
                     np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias)

@@ -65,6 +65,53 @@ def test_heartbeats_recorded(fitted):
     assert model.train_state.words_processed > 0
 
 
+def test_heartbeats_sample_real_loss_despite_fast_twin():
+    """The trainer dispatches a metrics-elided step twin for chunks no
+    heartbeat samples (PERF.md §4). Heartbeat rows must still carry the REAL
+    loss — a 0.0 loss in a heartbeat means the elision prediction missed."""
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    sents = two_topic_corpus(400)
+    vocab = build_vocab(sents, 1)
+    cfg = Word2VecConfig(vector_size=16, window=3, negatives=3, min_count=1,
+                         num_iterations=4, pairs_per_batch=128, negative_pool=16,
+                         steps_per_dispatch=2, heartbeat_every_steps=8,
+                         subsample_ratio=0.0, seed=1)
+    t = Trainer(cfg, vocab)
+    assert t._step_fn_fast is not t._step_fn  # shared-pool path builds the twin
+    # count twin usage: the elision must actually run (a regression that always
+    # picks the full twin would otherwise pass every assertion below)
+    used = {"fast": 0, "full": 0}
+    fast, full = t._step_fn_fast, t._step_fn
+
+    def fast_counting(*a, **kw):
+        used["fast"] += 1
+        return fast(*a, **kw)
+
+    def full_counting(*a, **kw):
+        used["full"] += 1
+        return full(*a, **kw)
+
+    t._step_fn_fast, t._step_fn = fast_counting, full_counting
+    t.fit(encode_sentences(sents, vocab, cfg.max_sentence_length))
+    assert t.heartbeats, "cadence 8 over hundreds of steps must fire"
+    assert all(np.isfinite(h.loss) and h.loss > 0.0 for h in t.heartbeats)
+    assert used["fast"] > 0 and used["full"] > 0, used
+
+    # and the twins really are interchangeable: the same fit with elision
+    # disabled (fast twin never used) lands on bit-identical params
+    t2 = Trainer(cfg, vocab)
+    t2._step_fn_fast = t2._step_fn
+    t2.fit(encode_sentences(sents, vocab, cfg.max_sentence_length))
+    np.testing.assert_array_equal(np.asarray(t.params.syn0),
+                                  np.asarray(t2.params.syn0))
+    np.testing.assert_array_equal(np.asarray(t.params.syn1),
+                                  np.asarray(t2.params.syn1))
+
+
 def test_save_load_resume(tmp_path, fitted):
     model, sents = fitted
     path = str(tmp_path / "m")
@@ -278,7 +325,9 @@ def test_exact_step_resume_matches_uninterrupted(tmp_path):
             raise StopTraining()
         return orig_fn(*a, **kw)
 
-    tr._step_fn = counting
+    # patch BOTH twins: _dispatch_step_fn may hand out the metrics-elided twin
+    # for chunks no heartbeat samples
+    tr._step_fn = tr._step_fn_fast = counting
     try:
         tr.fit(enc)
     except StopTraining:

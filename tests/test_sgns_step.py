@@ -323,6 +323,24 @@ def test_shared_pool_bf16_logits_tracks_f32(setup):
     np.testing.assert_allclose(float(mc_lo.loss), float(mc_ref.loss), rtol=2e-2)
 
 
+def test_shared_pool_metrics_elision_bit_identical(setup):
+    """with_metrics=False (the trainer's fast twin for chunks no heartbeat
+    samples, PERF.md §4) must change ONLY the metric side-channel: parameters
+    bit-identical, pairs exact, loss/mean_f_pos zeroed."""
+    from glint_word2vec_tpu.ops.sgns import sgns_step_shared_core
+    params, table, centers, contexts, mask = setup
+    negs = jnp.asarray(np.random.default_rng(9).integers(0, V, 16), jnp.int32)
+    full, m_full = sgns_step_shared_core(
+        params, centers, contexts, mask, negs, jnp.float32(0.05), N)
+    fast, m_fast = sgns_step_shared_core(
+        params, centers, contexts, mask, negs, jnp.float32(0.05), N,
+        with_metrics=False)
+    np.testing.assert_array_equal(np.asarray(full.syn0), np.asarray(fast.syn0))
+    np.testing.assert_array_equal(np.asarray(full.syn1), np.asarray(fast.syn1))
+    assert float(m_fast.pairs) == float(m_full.pairs) == B
+    assert float(m_fast.loss) == 0.0 and float(m_full.loss) > 0.0
+
+
 def test_shared_pool_duplicate_scaling_mean_semantics():
     """With duplicate_scaling=True on the shared-pool path, R identical pairs move
     each row exactly as far as ONE pair does (mean of identical updates), bounding the

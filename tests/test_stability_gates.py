@@ -11,12 +11,14 @@ stability key (incl. subsample_ratio and logits_dtype) so it can no longer
 bless the measured-NaN config.
 """
 
+import os
 import sys
 
 import numpy as np
 import pytest
 
-sys.path.insert(0, "/root/repo")
+# repo root (bench.py lives there, outside the package)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from glint_word2vec_tpu.config import Word2VecConfig
 from glint_word2vec_tpu.data.vocab import Vocabulary
