@@ -118,6 +118,71 @@ def test_second_process_serves_checkpoint(tmp_path):
 
 
 @pytest.mark.slow
+def test_concurrent_train_and_serve(tmp_path):
+    """The reference's deployment story as ONE RUNNING SYSTEM (README.md:45-57):
+    training runs and keeps writing checkpoints while a separate serving process
+    reloads live and answers queries MID-TRAINING. Every reload must land on a
+    consistent checkpoint (the swap/retry path in serve_checkpoint.py absorbs
+    the atomic-swap window — no torn reads may surface as errors or as synonym
+    rows outside the vocabulary)."""
+    import threading
+
+    sents = _corpus(n=600, seed=7)
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
+                         num_iterations=8, window=2, negatives=3,
+                         negative_pool=8, steps_per_dispatch=2, seed=13)
+    trainer = Trainer(cfg, vocab)
+    encoded = encode_sentences(sents, vocab, cfg.max_sentence_length)
+    ck = str(tmp_path / "model")
+    trainer.save_checkpoint(ck)  # the server needs a first checkpoint to boot
+
+    fit_err = []
+
+    def fit():
+        try:
+            # checkpoint every 4 global steps: many atomic swaps race the
+            # server's reloads below
+            trainer.fit(encoded, checkpoint_path=ck, checkpoint_every_steps=4)
+            trainer.save_checkpoint(ck)
+        except Exception as e:  # noqa: BLE001 — re-raised via fit_err
+            fit_err.append(e)
+
+    srv = _Server(ck)
+    t = threading.Thread(target=fit)
+    try:
+        t.start()
+        cycles = 0
+        words = {f"w{i}" for i in range(60)}
+        while t.is_alive():
+            r = srv.ask(op="reload")
+            assert r.get("reloaded"), f"torn reload surfaced: {r}"
+            assert r["num_words"] == vocab.size
+            got = srv.ask(op="synonyms", word="w0", num=5)
+            assert "error" not in got, got
+            assert len(got["synonyms"]) == 5
+            assert all(w in words and np.isfinite(s)
+                       for w, s in got["synonyms"]), got
+            info = srv.ask(op="info")
+            assert "error" not in info, info
+            cycles += 1
+        t.join()
+        assert not fit_err, fit_err
+        # the overlap was real: many reload+query cycles ran during training
+        assert cycles >= 5, f"only {cycles} cycles overlapped training"
+
+        # after training: one more reload sees the FINAL checkpoint exactly
+        assert srv.ask(op="reload")["reloaded"]
+        from glint_word2vec_tpu.models.word2vec import Word2VecModel
+        want = Word2VecModel.load(ck).find_synonyms("w0", 5)
+        got = srv.ask(op="synonyms", word="w0", num=5)["synonyms"]
+        assert [w for w, _ in got] == [w for w, _ in want]
+    finally:
+        srv.close()
+        t.join(timeout=60)
+
+
+@pytest.mark.slow
 def test_serving_row_shards_onto_own_mesh(tmp_path):
     """Row-shards checkpoint served by a process that streams it onto its own 8-way
     mesh — no dense [V, D] host copy in the serving process."""

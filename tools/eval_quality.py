@@ -50,18 +50,35 @@ LAMBDA = 0.72
 SENT_LEN = 35
 V_RAW = 90_000   # raw types; min_count=5 trims the tail to ~text8's ~70k
 
-# Relational structure (round-4, VERDICT item 5): E entity PAIRS (a_i, b_i) — the
-# synthetic analog of the toy corpus's country/capital pairs (it spec:22-37). Both
-# members of pair i co-occur with topic (i mod T_TOPICS)'s words; a-words additionally
-# co-occur with a shared role-A word set, b-words with role-B. The embedding must
-# therefore place b_i - a_i ≈ roleB - roleA for every i, which is exactly what the
-# reference's analogy gate (wien - österreich + deutschland ≈ berlin, it spec:327-352)
-# measures — now quantitatively, at 90k-vocab scale, with accuracy@1 over all pairs.
-N_ENTITIES = 96        # entity pairs (192 entity word types)
-ROLE_WORDS = 60        # per role set
-REL_SENT_FRAC = 0.06   # fraction of sentences that are relation sentences
+# Relational structure — the synthetic analog of the toy corpus's country/capital
+# pairs (it spec:22-37): entity pair members co-occur with a topic's words; a-words
+# additionally co-occur with a role-A word set, b-words with role-B, so the
+# embedding must place b_i - a_i ≈ roleB - roleA — exactly what the reference's
+# analogy gate (wien - österreich + deutschland ≈ berlin, it spec:327-352)
+# measures, quantitatively at 90k-vocab scale with accuracy@1.
+#
+# v2 (round-5, VERDICT item 4): the v1 gate saturated (every 90k headline run
+# scored acc@1 = 1.000 — it could no longer rank configs). v2 hardens it with
+# THREE relation families, each with its OWN role-word sets (family offsets
+# differ, so cross-family confusion is possible), a 2.4x lower total relation-
+# sentence rate, a 1:many family, and a rare family whose pairs see ~10x fewer
+# sentences than v1 gave every pair:
+#   freq — 40 one-to-one pairs, 60% of relation sentences (the v1 regime, thinner)
+#   many — 32 a-entities x 2 b-entities each (1:many), 30%
+#   rare — 24 one-to-one pairs, 10% (~0.01% of ALL sentences per pair)
+GEN_VERSION = 2
+REL_SENT_FRAC = 0.025  # fraction of sentences that are relation sentences (v1: 0.06)
+FAMILIES = (
+    {"key": "freq", "na": 40, "nb_per_a": 1, "weight": 0.60},
+    {"key": "many", "na": 32, "nb_per_a": 2, "weight": 0.30},
+    {"key": "rare", "na": 24, "nb_per_a": 1, "weight": 0.10},
+)
+ROLE_WORDS = 60        # per role set (each family has its own A and B sets)
 REL_LAMBDA_ENTITY = 0.18  # slots holding the entity word itself
 REL_LAMBDA_ROLE = 0.30    # slots drawn from the role word set; rest: topic/noise
+
+# v1 layout (kept so --rescore still scores round-4 models)
+N_ENTITIES = 96
 
 
 def log(msg):
@@ -82,7 +99,7 @@ def word_names(v: int) -> np.ndarray:
 
 
 def relation_names():
-    """Entity/role word types appended after the V_RAW topic types."""
+    """v1 entity/role word types (kept for --rescore of round-4 models)."""
     ea = [f"ea_{i:03d}" for i in range(N_ENTITIES)]
     eb = [f"eb_{i:03d}" for i in range(N_ENTITIES)]
     ra = [f"ra_w{i:03d}" for i in range(ROLE_WORDS)]
@@ -90,24 +107,60 @@ def relation_names():
     return ea, eb, ra, rb
 
 
-def generate_corpus(path: str, n_words: int, seed: int, v_raw: int = V_RAW) -> None:
-    """Write the topic-model corpus as a token file, one sentence per line.
+def family_names():
+    """v2 per-family entity/role word types, appended after the V_RAW topic
+    types in family order: a-entities, b-entities (a-major: b's of a-entity i
+    are indices i*nb_per_a .. i*nb_per_a+nb_per_a-1), role-A, role-B."""
+    fams = []
+    for f_idx, fam in enumerate(FAMILIES):
+        nb = fam["na"] * fam["nb_per_a"]
+        fams.append({
+            "key": fam["key"],
+            "a": [f"f{f_idx}a_{i:03d}" for i in range(fam["na"])],
+            "b": [f"f{f_idx}b_{i:03d}" for i in range(nb)],
+            "ra": [f"r{f_idx}a_w{i:03d}" for i in range(ROLE_WORDS)],
+            "rb": [f"r{f_idx}b_w{i:03d}" for i in range(ROLE_WORDS)],
+            "nb_per_a": fam["nb_per_a"],
+        })
+    return fams
 
-    A REL_SENT_FRAC fraction of sentences are relation sentences: entity word
-    (a_i or b_i) + role-set draws + the entity's topic words + noise."""
+
+def generate_corpus(path: str, n_words: int, seed: int, v_raw: int = V_RAW) -> None:
+    """Write the topic-model corpus as a token file, one sentence per line
+    (v2 relation structure — see the constants block).
+
+    A REL_SENT_FRAC fraction of sentences are relation sentences: one family
+    drawn by weight, one of its entity words (a_i, or one of a_i's b's) + that
+    family's role-set draws + the entity's topic words + noise."""
     rng = np.random.default_rng(seed)
     p = 1.0 / (np.arange(v_raw) + 10.0) ** 1.05
     p /= p.sum()
     names = word_names(v_raw)
-    ea, eb, ra, rb = relation_names()
-    all_names = np.concatenate([names, ea, eb, ra, rb])
+    fams = family_names()
+    all_names = np.concatenate(
+        [names] + [np.asarray(f[k]) for f in fams for k in ("a", "b", "ra", "rb")])
     topics = topic_of(np.arange(v_raw))
     topic_words = [np.where(topics == z)[0] for z in range(T_TOPICS)]
     topic_probs = [p[w] / p[w].sum() for w in topic_words]
-    ent_a = v_raw + np.arange(N_ENTITIES)
-    ent_b = ent_a + N_ENTITIES
-    role_a = ent_b[-1] + 1 + np.arange(ROLE_WORDS)
-    role_b = role_a[-1] + 1 + np.arange(ROLE_WORDS)
+    # id layout mirrors all_names: per family, a / b / role-A / role-B blocks
+    base = v_raw
+    fam_ids = []
+    fam_off = []
+    for f_idx, f in enumerate(fams):
+        ids = {"a": base + np.arange(len(f["a"]))}
+        base += len(f["a"])
+        ids["b"] = base + np.arange(len(f["b"]))
+        base += len(f["b"])
+        ids["ra"] = base + np.arange(ROLE_WORDS)
+        base += ROLE_WORDS
+        ids["rb"] = base + np.arange(ROLE_WORDS)
+        base += ROLE_WORDS
+        fam_ids.append(ids)
+        # family topic offset: a-entity i of family f sits in topic
+        # (17*f + i) mod T — distinct families' entities spread over topics
+        fam_off.append(17 * f_idx)
+    weights = np.asarray([f["weight"] for f in FAMILIES], np.float64)
+    weights /= weights.sum()
 
     n_sents = n_words // SENT_LEN
     t0 = time.perf_counter()
@@ -121,10 +174,23 @@ def generate_corpus(path: str, n_words: int, seed: int, v_raw: int = V_RAW) -> N
             # topic-bound slots per topic group
             words[:] = rng.choice(v_raw, size=(nb, SENT_LEN), p=p)
             from_topic = rng.random((nb, SENT_LEN)) < LAMBDA
-            # relation sentences: force the topic to the entity's own topic
+            # relation sentences: family by weight, entity within family,
+            # side a/b 50:50 (b: uniform over the a-entity's b's); the topic is
+            # forced to the entity's own topic
             is_rel = rng.random(nb) < REL_SENT_FRAC
-            ent = rng.integers(0, N_ENTITIES, nb)
-            z = np.where(is_rel, ent % T_TOPICS, z)
+            fam_draw = rng.choice(len(FAMILIES), size=nb, p=weights)
+            ent_word = np.zeros(nb, np.int32)
+            for f_idx, (fam, ids) in enumerate(zip(FAMILIES, fam_ids)):
+                rows = np.where(is_rel & (fam_draw == f_idx))[0]
+                if not rows.size:
+                    continue
+                ai = rng.integers(0, fam["na"], rows.size)
+                side_b = rng.random(rows.size) < 0.5
+                bk = ai * fam["nb_per_a"] + rng.integers(
+                    0, fam["nb_per_a"], rows.size)
+                ent_word[rows] = np.where(side_b, ids["b"][bk], ids["a"][ai])
+                z[rows] = (fam_off[f_idx] + ai) % T_TOPICS
+                # role draws happen below against the row's family sets
             for zz in np.unique(z):
                 rows = np.where(z == zz)[0]
                 m = from_topic[rows]
@@ -134,26 +200,31 @@ def generate_corpus(path: str, n_words: int, seed: int, v_raw: int = V_RAW) -> N
             # overwrite entity/role slots of relation sentences
             rel_rows = np.where(is_rel)[0]
             if rel_rows.size:
-                side_b = rng.random(rel_rows.size) < 0.5
                 u = rng.random((rel_rows.size, SENT_LEN))
                 ent_slot = u < REL_LAMBDA_ENTITY
                 role_slot = (u >= REL_LAMBDA_ENTITY) & (
                     u < REL_LAMBDA_ENTITY + REL_LAMBDA_ROLE)
-                ent_word = np.where(side_b, ent_b[ent[rel_rows]],
-                                    ent_a[ent[rel_rows]])
-                rw = np.where(side_b[:, None],
-                              role_b[rng.integers(0, ROLE_WORDS,
-                                                  (rel_rows.size, SENT_LEN))],
-                              role_a[rng.integers(0, ROLE_WORDS,
-                                                  (rel_rows.size, SENT_LEN))])
+                # the entity's side decides the role set: a-side rows draw from
+                # the family's role-A set, b-side from role-B
+                rw = np.empty((rel_rows.size, SENT_LEN), np.int32)
+                for f_idx, ids in enumerate(fam_ids):
+                    sub = np.where(fam_draw[rel_rows] == f_idx)[0]
+                    if not sub.size:
+                        continue
+                    on_b = np.isin(ent_word[rel_rows[sub]], ids["b"])
+                    draw = rng.integers(0, ROLE_WORDS, (sub.size, SENT_LEN))
+                    rw[sub] = np.where(on_b[:, None], ids["rb"][draw],
+                                       ids["ra"][draw])
                 sub = words[rel_rows]
-                sub = np.where(ent_slot, ent_word[:, None], sub)
+                sub = np.where(ent_slot, ent_word[rel_rows, None], sub)
                 sub = np.where(role_slot, rw, sub)
                 words[rel_rows] = sub
             lines = [" ".join(all_names[row]) for row in words]
             f.write("\n".join(lines) + "\n")
-    log(f"corpus: {n_sents:,} sentences / {n_sents * SENT_LEN:,} words "
-        f"({REL_SENT_FRAC:.0%} relation sentences, {N_ENTITIES} entity pairs) "
+    n_pairs = sum(f["na"] * f["nb_per_a"] for f in FAMILIES)
+    log(f"corpus v{GEN_VERSION}: {n_sents:,} sentences / "
+        f"{n_sents * SENT_LEN:,} words ({REL_SENT_FRAC:.1%} relation sentences, "
+        f"{n_pairs} entity pairs over {len(FAMILIES)} families) "
         f"written in {time.perf_counter() - t0:.1f}s -> {path}")
 
 
@@ -242,11 +313,74 @@ def evaluate(words, emb: np.ndarray, index=None) -> dict:
 def evaluate_analogies(index, emb: np.ndarray) -> dict:
     """The reference's analogy gate (wien − österreich + deutschland ≈ berlin,
     it spec:327-352) run quantitatively over the generator's entity pairs:
-    for ordered pairs (i, j), query v = b_i − a_i + a_j and check that the
+    for a-entities (i, j), query v = b_i − a_i + a_j and check that the
     cosine-nearest word over the FULL vocabulary (query words excluded, like the
-    reference's findSynonyms excludes the query) is b_j. Reports accuracy@1 and
-    the mean cosine to the correct answer (the gate's >0.9 analog). Device-side:
-    at 1M vocab the [queries, V] similarity matrix must not cross to the host."""
+    reference's findSynonyms excludes the query) is one of a_j's b-entities.
+    v2: scored PER FAMILY (freq / many / rare) plus the overall mean, so the
+    gate ranks configs instead of saturating. Device-side: at 1M vocab the
+    [queries, V] similarity matrix must not cross to the host."""
+    import jax
+    import jax.numpy as jnp
+
+    fams = family_names()
+    if fams[0]["a"][0] not in index and relation_names()[0][0] in index:
+        return _evaluate_analogies_v1(index, emb)
+
+    en_all = jnp.asarray(emb)
+
+    @jax.jit
+    def device_analogy(e, a_i, b_ik, a_j, b_j_set):
+        # b_j_set: [n_q, nb] — ALL correct answers for a_j (1:many families)
+        en = e / jnp.maximum(jnp.linalg.norm(e, axis=1, keepdims=True), 1e-12)
+        v = en[b_ik] - en[a_i] + en[a_j]
+        v = v / jnp.maximum(jnp.linalg.norm(v, axis=1, keepdims=True), 1e-12)
+        sims = v @ en.T                       # [n_q, V] — stays on device
+        rows = jnp.arange(sims.shape[0])
+        cos_correct = jnp.take_along_axis(sims, b_j_set, axis=1).max(axis=1)
+        sims = sims.at[rows, a_i].set(-jnp.inf)
+        sims = sims.at[rows, b_ik].set(-jnp.inf)
+        sims = sims.at[rows, a_j].set(-jnp.inf)
+        top1 = sims.argmax(axis=1)
+        hit = (top1[:, None] == b_j_set).any(axis=1)
+        return hit.mean(), cos_correct.mean()
+
+    rng = np.random.default_rng(7)
+    out = {}
+    accs, total_pairs, total_q = [], 0, 0
+    for fam in fams:
+        ia = np.asarray([index.get(w, -1) for w in fam["a"]])
+        ib = np.asarray([index.get(w, -1) for w in fam["b"]])
+        nb = fam["nb_per_a"]
+        ok_a = (ia >= 0) & (ib.reshape(-1, nb) >= 0).all(axis=1)
+        a_ids = ia[ok_a]
+        b_sets = ib.reshape(-1, nb)[ok_a]     # [na_ok, nb]
+        n = a_ids.size
+        total_pairs += int((ib >= 0).sum())
+        if n < 4:
+            out[f"analogy_{fam['key']}_pairs"] = int(n)
+            continue
+        n_q = min(256, n * (n - 1) * nb)
+        qi = rng.integers(0, n, n_q)
+        qk = rng.integers(0, nb, n_q)
+        qj = rng.integers(0, n - 1, n_q)
+        qj = np.where(qj >= qi, qj + 1, qj)   # j != i
+        acc, cos_mean = device_analogy(
+            en_all, jnp.asarray(a_ids[qi]), jnp.asarray(b_sets[qi, qk]),
+            jnp.asarray(a_ids[qj]), jnp.asarray(b_sets[qj]))
+        out[f"analogy_{fam['key']}_accuracy_at_1"] = round(float(acc), 4)
+        out[f"analogy_{fam['key']}_mean_cosine"] = round(float(cos_mean), 4)
+        accs.append(float(acc))
+        total_q += n_q
+    if accs:
+        out["analogy_accuracy_at_1"] = round(float(np.mean(accs)), 4)
+    out["analogy_pairs_in_vocab"] = total_pairs
+    out["analogy_queries"] = total_q
+    out["gen_version"] = GEN_VERSION
+    return out
+
+
+def _evaluate_analogies_v1(index, emb: np.ndarray) -> dict:
+    """v1 single-relation scoring — kept so --rescore works on round-4 models."""
     import jax
     import jax.numpy as jnp
 
@@ -286,6 +420,7 @@ def evaluate_analogies(index, emb: np.ndarray) -> dict:
         "analogy_queries": int(n_q),
         "analogy_accuracy_at_1": round(float(acc), 4),
         "analogy_mean_cosine_to_answer": round(float(cos_mean), 4),
+        "gen_version": 1,
     }
 
 
@@ -357,7 +492,8 @@ def main():
         corpus_path = args.corpus
     else:
         corpus_path = os.path.join(
-            args.out, f"corpus_{args.words}_{args.vocab}_{args.seed}.txt")
+            args.out,
+            f"corpus_v{GEN_VERSION}_{args.words}_{args.vocab}_{args.seed}.txt")
         if not os.path.exists(corpus_path):
             generate_corpus(corpus_path, args.words, args.seed, args.vocab)
         else:
@@ -378,7 +514,8 @@ def main():
         device_pairgen=args.device_pairgen, cbow=args.cbow)
     t0 = time.perf_counter()
     model = est.fit(sents, encode_cache_dir=os.path.join(
-        args.out, f"encoded_{args.words}_{args.vocab}_{args.min_count}"))
+        args.out,
+        f"encoded_v{GEN_VERSION}_{args.words}_{args.vocab}_{args.min_count}"))
     train_s = time.perf_counter() - t0
     log(f"trained: vocab {model.num_words:,}, d={args.dim}, {args.iters} iters "
         f"in {train_s:.0f}s (incl. vocab+encode passes)")
