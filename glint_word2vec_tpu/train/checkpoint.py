@@ -292,6 +292,21 @@ class ShardedMatrixReader:
     """Memory-mapped reader over a ``*.shards/`` directory: row-range reads without
     assembling the full matrix."""
 
+    # np.save writes bfloat16 (an ml_dtypes type numpy has no descr for) as raw
+    # 2-byte void '|V2', and np.load hands the void dtype back — assignments and
+    # math on it then fail with "No cast function available". The bf16 trainer
+    # is the only 2-byte-void producer in this codebase, so reads re-view the
+    # bytes as bfloat16. (The dense layout is unaffected: save_model converts
+    # to float32 on write.)
+    _VOID2 = np.dtype("V2")
+
+    @classmethod
+    def _undo_void(cls, arr: np.ndarray) -> np.ndarray:
+        if arr.dtype == cls._VOID2:
+            import ml_dtypes
+            return arr.view(ml_dtypes.bfloat16)
+        return arr
+
     def __init__(self, dirpath: str):
         self.dirpath = dirpath
         self._spans: List[tuple] = []
@@ -305,7 +320,8 @@ class ShardedMatrixReader:
             raise FileNotFoundError(f"no shard files under {dirpath!r}")
         self._spans.sort()
         self.rows = self._spans[-1][1]
-        probe = np.load(os.path.join(dirpath, self._spans[0][2]), mmap_mode="r")
+        probe = self._undo_void(
+            np.load(os.path.join(dirpath, self._spans[0][2]), mmap_mode="r"))
         self.cols = probe.shape[1]
         self.dtype = probe.dtype
         prev = 0
@@ -324,7 +340,8 @@ class ShardedMatrixReader:
             lo, hi = max(start, s), min(stop, e)
             if lo >= hi:
                 continue
-            m = np.load(os.path.join(self.dirpath, fname), mmap_mode="r")
+            m = self._undo_void(
+                np.load(os.path.join(self.dirpath, fname), mmap_mode="r"))
             out[lo - start:hi - start] = m[lo - s:hi - s]
         return out
 

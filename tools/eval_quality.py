@@ -60,22 +60,24 @@ V_RAW = 90_000   # raw types; min_count=5 trims the tail to ~text8's ~70k
 # v2 (round-5, VERDICT item 4): the v1 gate saturated (every 90k headline run
 # scored acc@1 = 1.000 — it could no longer rank configs). v2 hardens it with
 # THREE relation families, each with its OWN role-word sets (family offsets
-# differ, so cross-family confusion is possible), a 2.4x lower total relation-
-# sentence rate, a 1:many family, and a rare family whose pairs see ~10x fewer
-# sentences than v1 gave every pair:
+# differ, so cross-family confusion is possible), a 15x lower total relation-
+# sentence rate (0.06 -> 0.004), a 1:many family, and a rare family:
 #   freq — 40 one-to-one pairs, 60% of relation sentences (the v1 regime, thinner)
-#   many — 32 a-entities x 2 b-entities each (1:many), 30%
-#   rare — 24 one-to-one pairs, 10% (~0.01% of ALL sentences per pair)
+#   many — 32 a-entities x 2 b-entities each (1:many), 32%
+#   rare — 24 one-to-one pairs, 8% (~0.0013% of ALL sentences per pair —
+#          ~25 sentences per side at 60M words: an undertraining probe)
 GEN_VERSION = 2
-REL_SENT_FRAC = 0.025  # fraction of sentences that are relation sentences (v1: 0.06)
+# Tuned DOWN until the 60M-word/d300 headline config lands off the ceiling
+# (the first v2 candidate at 2.5%/0.18/0.30 still scored 1.0 everywhere):
+REL_SENT_FRAC = 0.004  # fraction of sentences that are relation sentences (v1: 0.06)
 FAMILIES = (
     {"key": "freq", "na": 40, "nb_per_a": 1, "weight": 0.60},
-    {"key": "many", "na": 32, "nb_per_a": 2, "weight": 0.30},
-    {"key": "rare", "na": 24, "nb_per_a": 1, "weight": 0.10},
+    {"key": "many", "na": 32, "nb_per_a": 2, "weight": 0.32},
+    {"key": "rare", "na": 24, "nb_per_a": 1, "weight": 0.08},
 )
 ROLE_WORDS = 60        # per role set (each family has its own A and B sets)
-REL_LAMBDA_ENTITY = 0.18  # slots holding the entity word itself
-REL_LAMBDA_ROLE = 0.30    # slots drawn from the role word set; rest: topic/noise
+REL_LAMBDA_ENTITY = 0.06  # slots holding the entity word itself
+REL_LAMBDA_ROLE = 0.10    # slots drawn from the role word set; rest: topic/noise
 
 # v1 layout (kept so --rescore still scores round-4 models)
 N_ENTITIES = 96
