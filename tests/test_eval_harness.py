@@ -1,0 +1,110 @@
+"""Unit tests for the quality-eval harness's v2 relation machinery
+(tools/eval_quality.py) — the scorer behind EVAL.md's analogy gate.
+
+The gate's numbers steer roadmap decisions (VERDICT r4 item 4), so its scoring
+must be pinned: a constructed embedding with EXACT relational geometry must
+score 1.0 per family, a random one ~0, and the generator must actually plant
+every family's words at its configured rate ordering."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import eval_quality as eq  # noqa: E402
+
+
+def _index_for(fams):
+    words = []
+    for f in fams:
+        words.extend(f["a"])
+        words.extend(f["b"])
+    return {w: i for i, w in enumerate(words)}
+
+
+def test_family_names_layout():
+    fams = eq.family_names()
+    assert [f["key"] for f in fams] == ["freq", "many", "rare"]
+    many = fams[1]
+    assert many["nb_per_a"] == 2
+    assert len(many["b"]) == 2 * len(many["a"])
+    # names are disjoint across families and from topic/stopword patterns
+    all_names = [w for f in fams for k in ("a", "b", "ra", "rb") for w in f[k]]
+    assert len(set(all_names)) == len(all_names)
+    assert not any(w.startswith(("t", "s_")) for w in all_names)
+
+
+def test_analogy_scorer_perfect_geometry_scores_one():
+    """b = a + family_offset exactly -> every family must score acc@1 = 1.0
+    (incl. the 1:many family, where any b of a_j counts)."""
+    fams = eq.family_names()
+    index = _index_for(fams)
+    rng = np.random.default_rng(0)
+    D = 32
+    emb = np.zeros((len(index), D), np.float32)
+    for f_idx, f in enumerate(fams):
+        offset = rng.standard_normal(D).astype(np.float32)
+        for i, a in enumerate(f["a"]):
+            base = rng.standard_normal(D).astype(np.float32)
+            emb[index[a]] = base
+            for k in range(f["nb_per_a"]):
+                b = f["b"][i * f["nb_per_a"] + k]
+                # tiny per-b jitter: distinct vectors, same offset direction
+                emb[index[b]] = base + offset * (1.0 + 0.001 * k)
+    out = eq.evaluate_analogies(index, emb)
+    assert out["gen_version"] == eq.GEN_VERSION
+    for key in ("freq", "many", "rare"):
+        assert out[f"analogy_{key}_accuracy_at_1"] == 1.0, out
+    assert out["analogy_accuracy_at_1"] == 1.0
+
+
+def test_analogy_scorer_random_geometry_scores_zero():
+    fams = eq.family_names()
+    index = _index_for(fams)
+    emb = np.random.default_rng(1).standard_normal(
+        (len(index), 16)).astype(np.float32)
+    out = eq.evaluate_analogies(index, emb)
+    assert out["analogy_accuracy_at_1"] < 0.1
+
+
+def test_v1_rescore_fallback():
+    """Round-4 models (old ea_/eb_ names) still score through the v1 path."""
+    ea, eb, _, _ = eq.relation_names()
+    index = {w: i for i, w in enumerate(ea + eb)}
+    rng = np.random.default_rng(2)
+    D = 16
+    offset = rng.standard_normal(D).astype(np.float32)
+    emb = np.zeros((len(index), D), np.float32)
+    for i, (a, b) in enumerate(zip(ea, eb)):
+        base = rng.standard_normal(D).astype(np.float32)
+        emb[index[a]] = base
+        emb[index[b]] = base + offset
+    out = eq.evaluate_analogies(index, emb)
+    assert out["gen_version"] == 1
+    assert out["analogy_accuracy_at_1"] == 1.0
+
+
+def test_generator_plants_all_families(tmp_path):
+    path = str(tmp_path / "c.txt")
+    eq.generate_corpus(path, n_words=700_000, seed=3, v_raw=2000)
+    from collections import Counter
+    counts = Counter()
+    with open(path) as f:
+        for line in f:
+            counts.update(line.split())
+    fams = eq.family_names()
+    occ = {f["key"]: sum(counts[w] for w in f["a"] + f["b"]) for f in fams}
+    # rate ordering follows the configured weights; every family is present
+    assert occ["freq"] > occ["many"] > occ["rare"] > 0, occ
+    # role words mark relation sentences of their family only
+    r0 = sum(counts[w] for w in fams[0]["ra"] + fams[0]["rb"])
+    assert r0 > 0
+    # non-relation content dominates (relation sentences are REL_SENT_FRAC)
+    total = sum(counts.values())
+    rel_tokens = sum(occ.values()) + sum(
+        counts[w] for f in fams for w in f["ra"] + f["rb"])
+    assert rel_tokens / total < 3 * eq.REL_SENT_FRAC

@@ -65,7 +65,8 @@ V_RAW = 90_000   # raw types; min_count=5 trims the tail to ~text8's ~70k
 #   freq — 40 one-to-one pairs, 60% of relation sentences (the v1 regime, thinner)
 #   many — 32 a-entities x 2 b-entities each (1:many), 32%
 #   rare — 24 one-to-one pairs, 8% (~0.0013% of ALL sentences per pair —
-#          ~25 sentences per side at 60M words: an undertraining probe)
+#          ~23 sentences per pair / ~11 per side at 60M words: an
+#          undertraining probe)
 GEN_VERSION = 2
 # Tuned DOWN until the 60M-word/d300 headline config lands off the ceiling
 # (the first v2 candidate at 2.5%/0.18/0.30 still scored 1.0 everywhere):
@@ -483,7 +484,12 @@ def main():
                   "pairs_per_batch": args.batch, "negative_pool": args.pool,
                   "subsample_ratio": args.subsample,
                   "device_pairgen": bool(args.device_pairgen),
-                  "cbow": bool(args.cbow), "min_count": args.min_count}
+                  "cbow": bool(args.cbow), "min_count": args.min_count,
+                  # generator-constants provenance: gen_version alone cannot
+                  # distinguish tuning iterations of the same version
+                  "rel_sent_frac": REL_SENT_FRAC,
+                  "rel_lambda_entity": REL_LAMBDA_ENTITY,
+                  "rel_lambda_role": REL_LAMBDA_ROLE}
         result.update(evaluate(words, emb.astype(np.float32)))
         print(json.dumps(result))
         with open(os.path.join(os.path.dirname(_here), "EVAL_RUNS.jsonl"),
@@ -542,6 +548,11 @@ def main():
         "device_pairgen": bool(args.device_pairgen),
         "cbow": bool(args.cbow),
         "min_count": args.min_count,
+        # generator-constants provenance (rows are only comparable within one
+        # constants set; gen_version alone cannot distinguish tuning rounds)
+        "rel_sent_frac": REL_SENT_FRAC,
+        "rel_lambda_entity": REL_LAMBDA_ENTITY,
+        "rel_lambda_role": REL_LAMBDA_ROLE,
     }
     if not args.corpus:
         result.update(evaluate(model.vocab.words,

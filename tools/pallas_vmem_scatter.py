@@ -26,10 +26,8 @@ Run: python tools/pallas_vmem_scatter.py [--h 2048] [--d 384] [--tile 1024]
 from __future__ import annotations
 
 import argparse
-import functools
 import os
 import sys
-import time
 
 import numpy as np
 
