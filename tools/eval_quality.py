@@ -295,12 +295,21 @@ def evaluate(words, emb: np.ndarray, index=None) -> dict:
     if np.isnan(emb).any():
         return {"diverged": True,
                 "nan_rows": int(np.isnan(emb).any(axis=1).sum())}
+    # finite-overflow telemetry: a slow-burn instability can wreck the geometry
+    # without ever reaching NaN (bf16 saturates at ~3.4e38 but quality collapses
+    # orders of magnitude earlier); record the scale so collapsed-purity rows
+    # are interpretable as blowup vs undertraining
+    row_max = np.abs(emb).max(axis=1)
+    abs_max = float(row_max.max())
+    blown = int((row_max > 100.0).sum())
     pur, margin = purity(emb)
     rnd = np.random.default_rng(1).standard_normal(
         emb.shape, dtype=np.float32)
     pur0, margin0 = purity(rnd)
     out = {
         "purity_at_10": round(pur, 4),
+        "emb_abs_max": round(abs_max, 3),
+        "rows_abs_over_100": blown,
         "purity_at_10_random_baseline": round(pur0, 4),
         "cosine_margin": round(margin, 4),
         "cosine_margin_random_baseline": round(margin0, 4),
@@ -446,6 +455,12 @@ def main():
                     help="raw word types in the generator (before min_count)")
     ap.add_argument("--min-count", type=int, default=5)
     ap.add_argument("--subsample", type=float, default=1e-4)
+    ap.add_argument("--lr", type=float, default=None,
+                    help="learning rate; default 0.025 (the 1.6M-vocab "
+                         "120M-word ladder rung measured a finite blowup at "
+                         "that default — lower lr is the mitigation probe). "
+                         "--rescore rows record this only when given "
+                         "explicitly (the saved model's lr is unknowable)")
     ap.add_argument("--device-pairgen", action="store_true",
                     help="use the on-device pair generator feed")
     ap.add_argument("--cbow", action="store_true",
@@ -465,6 +480,8 @@ def main():
 
     from glint_word2vec_tpu.data.corpus import TokenFileCorpus
     from glint_word2vec_tpu.models.estimator import Word2Vec
+
+    lr = args.lr if args.lr is not None else 0.025
 
     os.makedirs(args.out, exist_ok=True)
     if args.rescore:
@@ -489,7 +506,8 @@ def main():
                   # distinguish tuning iterations of the same version
                   "rel_sent_frac": REL_SENT_FRAC,
                   "rel_lambda_entity": REL_LAMBDA_ENTITY,
-                  "rel_lambda_role": REL_LAMBDA_ROLE}
+                  "rel_lambda_role": REL_LAMBDA_ROLE,
+                  "learning_rate": args.lr}
         result.update(evaluate(words, emb.astype(np.float32)))
         print(json.dumps(result))
         with open(os.path.join(os.path.dirname(_here), "EVAL_RUNS.jsonl"),
@@ -499,9 +517,14 @@ def main():
     if args.corpus:
         corpus_path = args.corpus
     else:
+        # cache key carries the tunable constants: a retune without a version
+        # bump must NOT silently reuse a stale corpus while the row records the
+        # new constants (the false-provenance hole the fields exist to prevent)
+        gen_tag = (f"v{GEN_VERSION}-{REL_SENT_FRAC:g}-{REL_LAMBDA_ENTITY:g}"
+                   f"-{REL_LAMBDA_ROLE:g}")
         corpus_path = os.path.join(
             args.out,
-            f"corpus_v{GEN_VERSION}_{args.words}_{args.vocab}_{args.seed}.txt")
+            f"corpus_{gen_tag}_{args.words}_{args.vocab}_{args.seed}.txt")
         if not os.path.exists(corpus_path):
             generate_corpus(corpus_path, args.words, args.seed, args.vocab)
         else:
@@ -512,7 +535,7 @@ def main():
         vector_size=args.dim, min_count=args.min_count, window=5, negatives=5,
         negative_pool=args.pool,
         pairs_per_batch=args.batch, steps_per_dispatch=32, num_iterations=args.iters,
-        learning_rate=0.025, subsample_ratio=args.subsample, seed=args.seed,
+        learning_rate=lr, subsample_ratio=args.subsample, seed=args.seed,
         param_dtype=args.param_dtype,
         compute_dtype=args.param_dtype,
         logits_dtype=args.logits_dtype or "float32",
@@ -522,8 +545,9 @@ def main():
         device_pairgen=args.device_pairgen, cbow=args.cbow)
     t0 = time.perf_counter()
     model = est.fit(sents, encode_cache_dir=os.path.join(
-        args.out,
-        f"encoded_v{GEN_VERSION}_{args.words}_{args.vocab}_{args.min_count}"))
+        args.out, (f"encoded_{gen_tag}_{args.words}_{args.vocab}"
+                   f"_{args.min_count}") if not args.corpus else
+        f"encoded_ext_{args.words}_{args.min_count}"))
     train_s = time.perf_counter() - t0
     log(f"trained: vocab {model.num_words:,}, d={args.dim}, {args.iters} iters "
         f"in {train_s:.0f}s (incl. vocab+encode passes)")
@@ -553,6 +577,7 @@ def main():
         "rel_sent_frac": REL_SENT_FRAC,
         "rel_lambda_entity": REL_LAMBDA_ENTITY,
         "rel_lambda_role": REL_LAMBDA_ROLE,
+        "learning_rate": lr,
     }
     if not args.corpus:
         result.update(evaluate(model.vocab.words,

@@ -64,9 +64,7 @@ def main() -> None:
 
         def body(i, _):
             h = idx_ref[i]
-            row = pl.load(x_ref, (pl.ds(i, 1), slice(None)))
-            cur = pl.load(o_ref, (pl.ds(h, 1), slice(None)))
-            pl.store(o_ref, (pl.ds(h, 1), slice(None)), cur + row)
+            o_ref[pl.ds(h, 1), :] += x_ref[pl.ds(i, 1), :]
             return 0
 
         jax.lax.fori_loop(0, T, body, 0)
