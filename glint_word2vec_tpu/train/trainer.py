@@ -359,8 +359,11 @@ class Trainer:
         - POOL load ``B·n/P``: every pool row absorbs the negative gradient of all B
           pairs scaled by n/P. B=64k/P=64 (load 5120) trains to NaN at lr 0.025; the
           same run at P=256 (load 1280) is stable with the best quality of the sweep.
-          The config default auto-scales the pool to load ≤ 600, so this fires only
-          on explicit pool choices.
+          The config default auto-scales the pool to load ≤ 600, so the generic
+          warning fires only on explicit pool choices — but the round-5
+          LARGE-VOCAB advisory (load > 300 at vocab > 500k, a measured finite-
+          blowup region) also covers the auto-scaled default: at large
+          vocabularies the default IS inside the measured danger zone.
         - DUPLICATE load ``B·max_word_share``: a frequent word's context occurrences
           scatter-add summed updates. With no subsampling the top Zipf word is ~1% of
           pairs (~650 summed updates at B=64k) and training explodes even at small
@@ -374,7 +377,24 @@ class Trainer:
         pool = cfg.negative_pool if cfg.negative_pool > 0 else 64  # pallas substitute
         pool_load = (cfg.pairs_per_batch * cfg.negatives / pool if check_pool
                      else 0.0)
-        if pool_load > 2000:
+        if pool_load > 300 and self.vocab.size > 500_000:
+            # large-vocab advisory (EVAL.md round-5 ladder) — takes precedence
+            # over the generic >2000 warning, whose "keep the load ~1300"
+            # advice sits deep inside the measured large-vocab blowup region.
+            # Mechanism: at 1.6M vocab a word serves in the pool only ~2x per
+            # run, so each service's load-sized summed update is never
+            # re-corrected — measured FINITE norm blowup (purity 0.99 -> 0.14,
+            # no NaN) at load 640 over 120M words; load 160 (pool 2048) fixed
+            # it at the same lr. The load <= 600 auto-rule is calibrated at
+            # 90k vocab; grow the pool for large-vocabulary long runs.
+            logger.warning(
+                "negative-pool load %.0f with a %d-word vocabulary: large-vocab "
+                "long runs measured a finite norm blowup in this region "
+                "(EVAL.md round-5 ladder — purity collapse without NaN at load "
+                "640, fixed at load 160); consider negative_pool >= %d",
+                pool_load, self.vocab.size,
+                128 * (-(-cfg.pairs_per_batch * cfg.negatives // (160 * 128))))
+        elif pool_load > 2000:
             logger.warning(
                 "pairs_per_batch*negatives/negative_pool = %.0f > 2000: pool-row "
                 "updates this large can diverge at default learning rates — scale "

@@ -507,7 +507,9 @@ def main():
                   "rel_sent_frac": REL_SENT_FRAC,
                   "rel_lambda_entity": REL_LAMBDA_ENTITY,
                   "rel_lambda_role": REL_LAMBDA_ROLE,
-                  "learning_rate": args.lr}
+                  # only when given explicitly: the saved model's lr is
+                  # unknowable here, and a default would fake provenance
+                  **({"learning_rate": args.lr} if args.lr is not None else {})}
         result.update(evaluate(words, emb.astype(np.float32)))
         print(json.dumps(result))
         with open(os.path.join(os.path.dirname(_here), "EVAL_RUNS.jsonl"),
