@@ -385,13 +385,16 @@ class Trainer:
             # run, so each service's load-sized summed update is never
             # re-corrected — measured FINITE norm blowup (purity 0.99 -> 0.14,
             # no NaN) at load 640 over 120M words; load 160 (pool 2048) fixed
-            # it at the same lr. The load <= 600 auto-rule is calibrated at
-            # 90k vocab; grow the pool for large-vocabulary long runs.
+            # that collapse at the same lr and tames norm growth ~8x at 240M
+            # (it delays the channel rather than eliminating it — EVAL.md).
+            # The load <= 600 auto-rule is calibrated at 90k vocab; grow the
+            # pool for large-vocabulary long runs.
             logger.warning(
                 "negative-pool load %.0f with a %d-word vocabulary: large-vocab "
                 "long runs measured a finite norm blowup in this region "
                 "(EVAL.md round-5 ladder — purity collapse without NaN at load "
-                "640, fixed at load 160); consider negative_pool >= %d",
+                "640; load 160 fixed that collapse and tames norm growth on "
+                "longer runs); consider negative_pool >= %d",
                 pool_load, self.vocab.size,
                 128 * (-(-cfg.pairs_per_batch * cfg.negatives // (160 * 128))))
         elif pool_load > 2000:
