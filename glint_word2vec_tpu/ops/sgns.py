@@ -454,13 +454,15 @@ def cbow_step_shared_core(
     sigmoid_mode: str = "exact",
     compute_dtype: jnp.dtype = jnp.float32,
     logits_dtype: jnp.dtype = jnp.float32,
+    with_metrics: bool = True,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """CBOW with a batch-shared negative pool — the CBOW analog of
     :func:`sgns_step_shared_core` (same estimator: each negative term reweighted by
     ``num_negatives / pool`` so the expected gradient matches per-example sampling;
     pool entries equal to an example's center are masked). All negative compute rides
     the MXU: ``f_neg = hidden @ Zᵀ`` and ``dZ = g_negᵀ @ hidden``. ``logits_dtype``
-    as in :func:`sgns_step_shared_core` (the [B, P] chain)."""
+    and ``with_metrics`` as in :func:`sgns_step_shared_core` (the [B, P] chain /
+    the trainer's metrics-elided fast twin)."""
     syn0, syn1 = params
     P = negatives.shape[0]
     neg_valid = (negatives[None, :] != centers[:, None]).astype(logits_dtype) \
@@ -497,15 +499,19 @@ def cbow_step_shared_core(
     new_syn1 = syn1.at[centers].add(d_out.astype(dtype))
     new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
 
-    denom = jnp.maximum((mask * has_ctx).sum(), 1.0)
-    loss = (-_log_sigmoid(f_pos) * mask * has_ctx
-            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid
-                      * has_ctx[:, None].astype(logits_dtype), axis=-1,
-                      dtype=jnp.float32)
-            * (num_negatives / P)).sum() / denom
+    if with_metrics:
+        denom = jnp.maximum((mask * has_ctx).sum(), 1.0)
+        loss = (-_log_sigmoid(f_pos) * mask * has_ctx
+                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid
+                          * has_ctx[:, None].astype(logits_dtype), axis=-1,
+                          dtype=jnp.float32)
+                * (num_negatives / P)).sum() / denom
+        mean_f_pos = (f_pos * mask * has_ctx).sum() / denom
+    else:
+        loss = mean_f_pos = jnp.float32(0.0)
     metrics = StepMetrics(
         loss=loss,
-        mean_f_pos=(f_pos * mask * has_ctx).sum() / denom,
+        mean_f_pos=mean_f_pos,
         pairs=(mask * has_ctx).sum(),
     )
     return EmbeddingPair(new_syn0, new_syn1), metrics

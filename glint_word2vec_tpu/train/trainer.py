@@ -318,12 +318,14 @@ class Trainer:
         self.pairs_trained = 0.0  # real (unmasked) pairs dispatched over this run
         self.heartbeats: List[HeartbeatRecord] = []
         self._step_fn = self._build_step()
-        # fast twin (metrics elided) for the shared-pool skip-gram path only:
-        # the one path whose loss side-channel is a measured slice of the step
+        # fast twin (metrics elided) for the shared-pool paths (skip-gram and
+        # CBOW): the paths whose loss side-channel is an extra full [B, pool]
+        # pass (PERF.md §4); the CBOW+duplicate_scaling and per-pair paths
+        # keep full metrics (their loss chains are not the measured slice)
         self._step_fn_fast = (
             self._build_step(with_metrics=False)
-            if (self.config.negative_pool > 0 and not self.config.cbow
-                and not self.config.use_pallas)
+            if (self.config.negative_pool > 0 and not self.config.use_pallas
+                and not (self.config.cbow and self.config.duplicate_scaling))
             else self._step_fn)
 
     # -- setup -------------------------------------------------------------------------
@@ -504,11 +506,12 @@ class Trainer:
 
     def _build_step(self, with_metrics: bool = True) -> Callable:
         """Build the jitted chunk function. ``with_metrics=False`` builds the
-        fast twin of the shared-pool skip-gram path: loss/mean_f_pos elided
-        (one fewer full [B, P] pass, ~0.3 ms at the headline shape — PERF.md
-        §4), pairs kept exact. The trainer dispatches the fast twin for chunks
-        no heartbeat will sample (see _dispatch_step_fn); both twins share the
-        same update math, so the trained parameters are bit-identical."""
+        fast twin of the shared-pool paths (skip-gram and CBOW):
+        loss/mean_f_pos elided (one fewer full [B, P] pass, ~0.3 ms at the
+        headline shape — PERF.md §4), pairs kept exact. The trainer dispatches
+        the fast twin for chunks no heartbeat will sample (see
+        _dispatch_step_fn); both twins share the same update math, so the
+        trained parameters are bit-identical."""
         cfg = self.config
         quiet = not with_metrics  # the full build already warned at __init__
         compute_dtype = jnp.dtype(cfg.compute_dtype)
@@ -567,13 +570,14 @@ class Trainer:
 
             neg_shape = shared_pool_shape
         elif cfg.cbow and cfg.negative_pool > 0 and not cfg.duplicate_scaling:
-            self._stability_warnings()
+            if not quiet:
+                self._stability_warnings()
 
             def inner(params, batch, negatives, alpha):
                 return cbow_step_shared_core(
                     params, batch["centers"], batch["contexts"], batch["ctx_mask"],
                     batch["mask"], negatives, alpha, cfg.negatives,
-                    cfg.sigmoid_mode, compute_dtype, logits_dtype)
+                    cfg.sigmoid_mode, compute_dtype, logits_dtype, with_metrics)
 
             neg_shape = shared_pool_shape
         elif cfg.cbow:

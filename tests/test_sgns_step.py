@@ -340,6 +340,21 @@ def test_shared_pool_metrics_elision_bit_identical(setup):
     assert float(m_fast.pairs) == float(m_full.pairs) == B
     assert float(m_fast.loss) == 0.0 and float(m_full.loss) > 0.0
 
+    # the CBOW shared-pool path has the same twin contract
+    from glint_word2vec_tpu.ops.sgns import cbow_step_shared_core
+    C = 4
+    ctx = jnp.asarray(np.random.default_rng(10).integers(0, V, (B, C)), jnp.int32)
+    cmask = jnp.ones((B, C), jnp.float32)
+    cf, mcf = cbow_step_shared_core(
+        params, centers, ctx, cmask, mask, negs, jnp.float32(0.05), N)
+    cq, mcq = cbow_step_shared_core(
+        params, centers, ctx, cmask, mask, negs, jnp.float32(0.05), N,
+        with_metrics=False)
+    np.testing.assert_array_equal(np.asarray(cf.syn0), np.asarray(cq.syn0))
+    np.testing.assert_array_equal(np.asarray(cf.syn1), np.asarray(cq.syn1))
+    assert float(mcq.pairs) == float(mcf.pairs)
+    assert float(mcq.loss) == 0.0 and float(mcf.loss) > 0.0
+
 
 def test_shared_pool_duplicate_scaling_mean_semantics():
     """With duplicate_scaling=True on the shared-pool path, R identical pairs move
