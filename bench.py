@@ -220,10 +220,15 @@ def bench_cbow_step(counts, b: int, pool: int, param_dtype: str = "bfloat16",
 
         def body(p, inp):
             batch, ng = inp
+            # with_metrics=False + params-carry fetch below: the same
+            # metrics-elided production regime bench_step measures — the
+            # trainer dispatches the elided twin on the CBOW shared-pool path
+            # too, so the CBOW and SGNS step rows stay comparable
             new_p, m = cbow_step_shared_core(
                 p, batch["centers"], batch["contexts"], batch["ctx_mask"],
                 batch["mask"], ng, jnp.float32(0.025), NEG, "exact", pdt,
-                jnp.bfloat16 if param_dtype == "bfloat16" else jnp.float32)
+                jnp.bfloat16 if param_dtype == "bfloat16" else jnp.float32,
+                with_metrics=False)
             return new_p, m.loss
 
         return jax.lax.scan(body, params, (batches, negs))
@@ -247,7 +252,10 @@ def bench_cbow_step(counts, b: int, pool: int, param_dtype: str = "bfloat16",
             lambda p, bt, base: f(p, bt, base, prob, alias),
             make_carry=lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
             args_for_iter=lambda i: (all_batches[i % 6], np.int32(100 + i)),
-            n_lo=2, n_hi=8, fetch=lambda c, out: out[-1])
+            n_lo=2, n_hi=8,
+            # loss is elided — the barrier fetch must depend on the updated
+            # params or the whole chain can be elided (same as bench_step)
+            fetch=lambda c, out: c.syn0[0, 0].astype(jnp.float32))
         ts.append(spc / K)
     spp = float(np.median(ts))
     # a CBOW "example" trains ~mean(nctx) positive word-context links; report

@@ -228,6 +228,33 @@ class Word2VecConfig:
                                     # subsample keep ratio (targeting ~93% pair-slot fill;
                                     # overflow pairs are dropped and counted)
 
+    # --- fault tolerance (docs/robustness.md; no reference analog — the
+    # reference leans on Spark task re-execution, SURVEY §5) ---
+    nonfinite_policy: str = "halt"  # what the trainer does when the params carry goes
+                                    # non-finite (bf16 blowup, divergence). Probed at
+                                    # heartbeat/checkpoint cadence on the params the
+                                    # heartbeat fetch already syncs on, so the fast
+                                    # metrics-elided step twin stays elided.
+                                    # "halt" (default): raise NonFiniteParamsError with
+                                    # a diagnostic instead of burning accelerator-hours
+                                    # training NaNs or overwriting a good checkpoint;
+                                    # "rollback": restore the newest in-memory good
+                                    # snapshot and jump the negative-sample counter
+                                    # lattice so the retried stretch draws a different
+                                    # sample path; "none": pre-round-6 behavior (no
+                                    # probe, NaNs train on silently)
+    rollback_history: int = 2       # nonfinite_policy="rollback": how many good param
+                                    # snapshots the in-memory ring holds. A rollback
+                                    # pops the newest; repeated blowups before the next
+                                    # finite probe step back through the older entries.
+                                    # Each snapshot is a device-resident copy of the
+                                    # padded [V, D] syn0+syn1 pair — budget HBM
+                                    # accordingly
+    max_rollbacks: int = 8          # nonfinite_policy="rollback": give up (raise) after
+                                    # this many rollbacks in one fit() — a run that
+                                    # keeps diverging needs a config change
+                                    # (lr/pool/subsample), not infinite retries
+
     def __post_init__(self) -> None:
         if self.embedding_partition not in ("rows", "cols"):
             raise ValueError(
@@ -312,6 +339,16 @@ class Word2VecConfig:
         if self.tokens_per_step < 0:
             raise ValueError(
                 f"tokens_per_step must be nonnegative but got {self.tokens_per_step}")
+        if self.nonfinite_policy not in ("halt", "rollback", "none"):
+            raise ValueError(
+                f"nonfinite_policy must be 'halt', 'rollback', or 'none' "
+                f"but got {self.nonfinite_policy!r}")
+        if self.rollback_history <= 0:
+            raise ValueError(
+                f"rollback_history must be positive but got {self.rollback_history}")
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be nonnegative but got {self.max_rollbacks}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
         if (getattr(self, "_auto_pool", False) and "negative_pool" not in kwargs

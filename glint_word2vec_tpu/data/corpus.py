@@ -22,12 +22,16 @@ Layout of an encoded dir (two flat binary files + a small JSON):
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Iterable, Iterator, List, Sequence
 
 import numpy as np
 
 from glint_word2vec_tpu.data.vocab import Vocabulary
+from glint_word2vec_tpu.train.faults import maybe_fail_ingest, retry_io
+
+logger = logging.getLogger("glint_word2vec_tpu")
 
 _TOKENS = "tokens.bin"
 _OFFSETS = "offsets.bin"
@@ -45,7 +49,14 @@ class TokenFileCorpus:
         self.lowercase = lowercase
 
     def __iter__(self) -> Iterator[List[str]]:
-        with open(self.path, "r", encoding="utf-8", errors="replace") as f:
+        def _open():
+            maybe_fail_ingest(f"corpus open {self.path!r}")
+            return open(self.path, "r", encoding="utf-8", errors="replace")
+
+        # the open is the flaky-NFS surface worth retrying; a failure mid-read
+        # propagates (the caller restarts the whole streaming pass — replaying
+        # from an arbitrary line offset could silently skip sentences)
+        with retry_io(_open, what=f"open corpus {self.path!r}") as f:
             for line in f:
                 if self.lowercase:
                     line = line.lower()
@@ -60,14 +71,25 @@ class EncodedCorpus(Sequence):
 
     def __init__(self, directory: str):
         self.directory = directory
-        with open(os.path.join(directory, _META), "r", encoding="utf-8") as f:
-            self.meta = json.load(f)
+
+        def _open_meta():
+            maybe_fail_ingest(f"encoded-corpus meta {directory!r}")
+            with open(os.path.join(directory, _META), "r",
+                      encoding="utf-8") as f:
+                return json.load(f)
+
+        self.meta = retry_io(
+            _open_meta, what=f"read encoded-corpus meta under {directory!r}")
         n = self.meta["n_sentences"]
-        self._tokens = np.memmap(
-            os.path.join(directory, _TOKENS), dtype=np.int32, mode="r")
-        self._offsets = np.memmap(
-            os.path.join(directory, _OFFSETS), dtype=np.int64, mode="r",
-            shape=(n + 1,))
+        self._tokens = retry_io(
+            lambda: np.memmap(
+                os.path.join(directory, _TOKENS), dtype=np.int32, mode="r"),
+            what=f"map {_TOKENS} under {directory!r}")
+        self._offsets = retry_io(
+            lambda: np.memmap(
+                os.path.join(directory, _OFFSETS), dtype=np.int64, mode="r",
+                shape=(n + 1,)),
+            what=f"map {_OFFSETS} under {directory!r}")
         if int(self._offsets[-1]) != self._tokens.shape[0]:
             raise ValueError(
                 f"corrupt encoded corpus at {directory}: last offset "
@@ -113,44 +135,67 @@ def encode_corpus(
         if ingest_native.ingest_available():
             tok_p = os.path.join(out_dir, _TOKENS)
             off_p = os.path.join(out_dir, _OFFSETS)
-            res = ingest_native.encode_corpus_native(
-                sentences.path, vocab.words, max_sentence_length,
-                tok_p, off_p, native.default_threads())
+            # the native pass retries internally (ingest_native.py); a hard
+            # failure — a None sentinel OR an exhausted retry budget — falls
+            # through to the Python pass below (which restarts clean)
+            try:
+                res = ingest_native.encode_corpus_native(
+                    sentences.path, vocab.words, max_sentence_length,
+                    tok_p, off_p, native.default_threads())
+            except OSError as e:
+                logger.warning("native corpus encode failed after retries "
+                               "(%s); falling back to the Python pass", e)
+                res = None
             if res is not None:
                 total_n, n_sents = res
                 _write_meta(out_dir, n_sents, total_n, max_sentence_length,
                             vocab)
                 return EncodedCorpus(out_dir)
     index = vocab.index
-    offsets: List[int] = [0]
-    total = 0
-    buf: List[np.ndarray] = []
-    buffered = 0
 
-    with open(os.path.join(out_dir, _TOKENS), "wb") as tf:
-        def flush():
-            nonlocal buf, buffered
-            if buf:
-                np.concatenate(buf).tofile(tf)
-                buf, buffered = [], 0
+    def python_pass() -> tuple:
+        """One full encode attempt, restartable from scratch: the tokens file
+        is opened "wb" (truncates any partial previous attempt) and all
+        position state is local, so the retry wrapper can simply re-run it."""
+        maybe_fail_ingest(f"corpus encode into {out_dir!r}")
+        offsets: List[int] = [0]
+        total = 0
+        buf: List[np.ndarray] = []
+        buffered = 0
 
-        for sentence in sentences:
-            ids = [index[w] for w in sentence if w in index]
-            if not ids:
-                continue
-            arr = np.asarray(ids, dtype=np.int32)
-            for start in range(0, len(arr), max_sentence_length):
-                chunk = arr[start:start + max_sentence_length]
-                if not chunk.size:
+        with open(os.path.join(out_dir, _TOKENS), "wb") as tf:
+            def flush():
+                nonlocal buf, buffered
+                if buf:
+                    np.concatenate(buf).tofile(tf)
+                    buf, buffered = [], 0
+
+            for sentence in sentences:
+                ids = [index[w] for w in sentence if w in index]
+                if not ids:
                     continue
-                buf.append(chunk)
-                buffered += 1
-                total += int(chunk.size)
-                offsets.append(total)
-                if buffered >= buffer_sentences:
-                    flush()
-        flush()
+                arr = np.asarray(ids, dtype=np.int32)
+                for start in range(0, len(arr), max_sentence_length):
+                    chunk = arr[start:start + max_sentence_length]
+                    if not chunk.size:
+                        continue
+                    buf.append(chunk)
+                    buffered += 1
+                    total += int(chunk.size)
+                    offsets.append(total)
+                    if buffered >= buffer_sentences:
+                        flush()
+            flush()
+        return offsets, total
 
+    if iter(sentences) is sentences:
+        # one-shot iterator: a retry would re-iterate the partially consumed
+        # generator and silently encode a truncated corpus — propagate instead
+        # (same hazard the read path's mid-read policy documents above)
+        offsets, total = python_pass()
+    else:
+        offsets, total = retry_io(
+            python_pass, what=f"encode corpus into {out_dir!r}")
     np.asarray(offsets, dtype=np.int64).tofile(os.path.join(out_dir, _OFFSETS))
     _write_meta(out_dir, len(offsets) - 1, total, max_sentence_length, vocab)
     return EncodedCorpus(out_dir)

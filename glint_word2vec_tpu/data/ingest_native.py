@@ -24,6 +24,8 @@ from typing import Optional
 
 import numpy as np
 
+from glint_word2vec_tpu.train.faults import maybe_fail_ingest, retry_io
+
 logger = logging.getLogger("glint_word2vec_tpu")
 
 _ABI_VERSION = 2
@@ -78,9 +80,14 @@ def count_words_native(corpus_path: str, n_threads: int):
     with tempfile.TemporaryDirectory(prefix="glint_ingest_") as td:
         wpath = os.path.join(td, "words")
         cpath = os.path.join(td, "counts")
-        n = lib.glint_ingest_count(
-            corpus_path.encode(), wpath.encode(), cpath.encode(),
-            np.int32(n_threads))
+
+        def attempt() -> int:
+            maybe_fail_ingest(f"native ingest count {corpus_path!r}")
+            return lib.glint_ingest_count(
+                corpus_path.encode(), wpath.encode(), cpath.encode(),
+                np.int32(n_threads))
+
+        n = retry_io(attempt, what=f"native ingest count {corpus_path!r}")
         if n == -2:
             logger.info("corpus %r needs Python tokenization semantics "
                         "(unicode whitespace / lone CR / invalid UTF-8); "
@@ -117,11 +124,19 @@ def encode_corpus_native(corpus_path: str, words, max_sentence_length: int,
         tf.write("\n".join(words).encode("utf-8") + b"\n")
     try:
         nsents = ctypes.c_int64(0)
-        total = lib.glint_ingest_encode(
-            corpus_path.encode(), vocab_path.encode(),
-            np.int32(max_sentence_length), tokens_path.encode(),
-            offsets_path.encode(), np.int32(n_threads),
-            ctypes.byref(nsents))
+
+        def attempt() -> int:
+            # the C pass truncates its output files on open, so a retried
+            # attempt restarts clean — same restart-from-scratch contract as
+            # the Python pass in corpus.py
+            maybe_fail_ingest(f"native ingest encode {corpus_path!r}")
+            return lib.glint_ingest_encode(
+                corpus_path.encode(), vocab_path.encode(),
+                np.int32(max_sentence_length), tokens_path.encode(),
+                offsets_path.encode(), np.int32(n_threads),
+                ctypes.byref(nsents))
+
+        total = retry_io(attempt, what=f"native ingest encode {corpus_path!r}")
     finally:
         os.unlink(vocab_path)
     if total == -2:

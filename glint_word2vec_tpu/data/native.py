@@ -51,7 +51,10 @@ def build_or_reload(src: str, lib_path: str, abi_symbol: str, abi_version: int,
         # sweep temp objects orphaned by builders killed mid-compile (unique
         # names mean nothing ever overwrites them); only files older than the
         # build timeout — younger ones may belong to a live concurrent builder
-        for stale in glob.glob(lib_path + ".tmp*"):  # incl. legacy fixed ".tmp"
+        # glob.escape: a cache path containing [, ?, * must match literally —
+        # unescaped it would silently sweep nothing (orphans accumulate) or
+        # match unrelated files for deletion
+        for stale in glob.glob(glob.escape(lib_path) + ".tmp*"):  # incl. legacy fixed ".tmp"
             try:
                 if time.time() - os.path.getmtime(stale) > 300:
                     os.unlink(stale)

@@ -83,6 +83,8 @@ class Word2Vec:
         plan: Optional[MeshPlan] = None,
         checkpoint_every_steps: Optional[int] = None,
         encode_cache_dir: Optional[str] = None,
+        allow_unstable: Optional[bool] = None,
+        config_overrides: Optional[dict] = None,
     ) -> Word2VecModel:
         """Resume an interrupted run from a mid-training checkpoint (capability the
         reference lacks — its runs are all-or-nothing, SURVEY §5). Resume is
@@ -95,7 +97,18 @@ class Word2Vec:
         encoded corpus whose vocab fingerprint matches the checkpoint's vocabulary, it
         is reused as-is (the common resume case — no re-encoding pass, unlike
         :meth:`fit` which always re-encodes); otherwise the sentences are streamed
-        into it. Either way training reads memory-mapped shards."""
+        into it. Either way training reads memory-mapped shards.
+
+        ``config_overrides``/``allow_unstable``: the rebuilt Trainer otherwise
+        takes the checkpoint's config verbatim, and checkpoints pin the
+        RESOLVED subsample_ratio (to_dict(auto_markers=False)) — so a
+        pre-round-5 checkpoint saved with the old default 1e-3 at a geometry
+        now inside the measured duplicate-overload refusal region would be
+        unresumable (ADVICE r5). ``allow_unstable=True`` overrides that
+        refusal for the resumed run (warn-only); ``config_overrides`` replaces
+        arbitrary config fields (e.g. ``{"subsample_ratio": 1e-4}``) — note
+        non-feed knobs that change the batch stream will shift the recorded
+        resume position's meaning."""
         import os
 
         from glint_word2vec_tpu.data.corpus import (
@@ -106,6 +119,10 @@ class Word2Vec:
 
         header = load_model_header(checkpoint_path)
         cfg: Word2VecConfig = header["config"]
+        if config_overrides:
+            cfg = cfg.replace(**config_overrides)
+        if allow_unstable is not None:
+            cfg = cfg.replace(allow_unstable=allow_unstable)
         state = header["train_state"]
         vocab = Vocabulary.from_words_and_counts(header["words"], header["counts"])
         streamed = None

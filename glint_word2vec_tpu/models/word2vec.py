@@ -343,7 +343,8 @@ class Word2VecModel:
             self.config, self.train_state)
 
     @classmethod
-    def load(cls, path: str, plan: Optional[MeshPlan] = None) -> "Word2VecModel":
+    def load(cls, path: str, plan: Optional[MeshPlan] = None,
+             verify: bool = True) -> "Word2VecModel":
         """Load a saved model; ``plan`` retargets the arrays onto a different mesh — the
         analog of the reference's load-onto-different-PS-topology overloads
         (mllib:696-725, ml:584-599).
@@ -352,7 +353,12 @@ class Word2VecModel:
         straight from the mmap'd shard files onto the target mesh
         (:func:`..train.checkpoint.load_params_into_plan`) — the full [V, D] matrices
         never materialize on any single host, so model ops (transform/find_synonyms)
-        work at vocabularies that exceed one host's memory."""
+        work at vocabularies that exceed one host's memory.
+
+        ``verify=False`` skips the digest (re-)hash on both layouts — for
+        callers that just verified (e.g. :meth:`load_latest`), or for skipping
+        the extra sequential shard read on a trusted very large row-shards
+        checkpoint."""
         header = None
         if plan is not None:
             header = ckpt.load_model_header(path)
@@ -361,11 +367,11 @@ class Word2VecModel:
                     header["words"], header["counts"])
                 Vp = pad_vocab_for_sharding(vocab.size, plan.num_model)
                 syn0, syn1 = ckpt.load_params_into_plan(
-                    path, plan, Vp, header["vector_size"])
+                    path, plan, Vp, header["vector_size"], verify=verify)
                 return cls(vocab=vocab, syn0=syn0, syn1=syn1,
                            config=header["config"], plan=plan,
                            train_state=header["train_state"])
-        data = ckpt.load_model(path, header=header)
+        data = ckpt.load_model(path, header=header, verify=verify)
         vocab = Vocabulary.from_words_and_counts(data["words"], data["counts"])
         return cls(
             vocab=vocab,
@@ -375,6 +381,21 @@ class Word2VecModel:
             plan=plan,
             train_state=data["train_state"],
         )
+
+    @classmethod
+    def load_latest(cls, directory: str, plan: Optional[MeshPlan] = None,
+                    reclaim: bool = False) -> "Word2VecModel":
+        """Serving-side recovery load: scan ``directory`` and load the newest
+        checkpoint whose content passes digest verification
+        (:func:`..train.checkpoint.load_latest_valid`). Non-destructive by
+        default (``reclaim=False``): safe to call while a trainer may still be
+        saving into the directory — debris is left alone, and a torn-swap
+        predecessor is loaded from its ``*.old-*`` path without renaming.
+        Pass ``reclaim=True`` only when the writer is known dead (true crash
+        recovery) to also clean the directory up. The scan already verified
+        the winner's digests, so the load itself skips the re-hash."""
+        return cls.load(ckpt.load_latest_valid(directory, reclaim=reclaim),
+                        plan=plan, verify=False)
 
     def stop(self) -> None:
         """Release device buffers — the analog of the reference's PS teardown

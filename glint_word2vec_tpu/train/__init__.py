@@ -1,4 +1,22 @@
-from glint_word2vec_tpu.train.checkpoint import TrainState, load_model, save_model
+from glint_word2vec_tpu.train.checkpoint import (
+    CheckpointCorruptError,
+    TrainState,
+    load_latest_valid,
+    load_model,
+    save_model,
+    verify_checkpoint,
+)
+from glint_word2vec_tpu.train.faults import NonFiniteParamsError
 from glint_word2vec_tpu.train.trainer import HeartbeatRecord, Trainer
 
-__all__ = ["TrainState", "load_model", "save_model", "HeartbeatRecord", "Trainer"]
+__all__ = [
+    "CheckpointCorruptError",
+    "NonFiniteParamsError",
+    "TrainState",
+    "load_latest_valid",
+    "load_model",
+    "save_model",
+    "verify_checkpoint",
+    "HeartbeatRecord",
+    "Trainer",
+]

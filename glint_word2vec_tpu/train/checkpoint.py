@@ -32,12 +32,21 @@ copy either (the "retarget a different PS topology" load path, mllib:696-725).
 
 Improvement over the reference: ``train_state`` records (iteration, words_processed), so a
 ``numIterations`` run is resumable mid-way — the reference is all-or-nothing (SURVEY §5).
+
+Integrity (docs/robustness.md): both writers record a per-file SHA-256 digest map in
+``metadata.json`` (additive — older readers ignore it, so no format bump); readers
+verify what they read, :func:`verify_checkpoint` audits a checkpoint without loading
+the matrices into device memory, and :func:`load_latest_valid` scans a directory of
+checkpoints, reclaims interrupted-save debris, and returns the newest one that
+verifies — the recovery entry point after a crash or preemption.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import logging
 import os
 import shutil
 from typing import Any, Dict, List, Optional
@@ -45,6 +54,9 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.train import faults
+
+logger = logging.getLogger("glint_word2vec_tpu")
 
 # Per-layout format stamps: the dense .npy layout is unchanged since round 1 and stays
 # at 1 (readers pinned to 1 keep working); the row-shards layout introduced 2; a
@@ -55,6 +67,20 @@ DENSE_FORMAT_VERSION = 1
 SHARDED_FORMAT_VERSION = 2
 SHARD_PROGRESS_FORMAT_VERSION = 3
 _READABLE_VERSIONS = (1, 2, 3)
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint failed integrity verification: missing/unparseable
+    metadata, a file named in the digest map absent, or content whose SHA-256
+    does not match the digest recorded at save time."""
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
 
 
 def _format_version(base: int, train_state: Optional["TrainState"]) -> int:
@@ -129,7 +155,9 @@ def save_model(
 ) -> None:
     """Atomic save: everything is written to a sibling temp directory first and swapped
     into place, so a crash mid-save never corrupts an existing checkpoint (the whole point
-    of ``checkpoint_every_steps``-style periodic saves)."""
+    of ``checkpoint_every_steps``-style periodic saves). Every data file's SHA-256 rides
+    in ``metadata.json["digests"]`` so readers (and :func:`load_latest_valid`) can tell
+    a torn or bit-rotted checkpoint from a good one."""
     bad = [w for w in words if (not w) or ("\n" in w)]
     if bad:
         raise ValueError(
@@ -142,14 +170,23 @@ def save_model(
         shutil.rmtree(tmp)
     os.makedirs(tmp)
     try:
-        with open(os.path.join(tmp, "words"), "w", encoding="utf-8") as f:
+        digests: Dict[str, str] = {}
+
+        def stage(name: str) -> str:
+            return os.path.join(tmp, name)
+
+        with open(stage("words"), "w", encoding="utf-8") as f:
             for w in words:
                 f.write(w + "\n")
-        np.save(os.path.join(tmp, "counts.npy"), np.asarray(counts, dtype=np.int64))
+        np.save(stage("counts.npy"), np.asarray(counts, dtype=np.int64))
         syn0 = np.asarray(syn0, dtype=np.float32)
-        np.save(os.path.join(tmp, "syn0.npy"), syn0)
+        np.save(stage("syn0.npy"), syn0)
         if syn1 is not None:
-            np.save(os.path.join(tmp, "syn1.npy"), np.asarray(syn1, dtype=np.float32))
+            np.save(stage("syn1.npy"), np.asarray(syn1, dtype=np.float32))
+        for name in ("words", "counts.npy", "syn0.npy", "syn1.npy"):
+            if os.path.exists(stage(name)):
+                digests[name] = _sha256_file(stage(name))
+        faults.crash_point("save:arrays-written")
         meta = {
             "format_version": _format_version(DENSE_FORMAT_VERSION, train_state),
             "framework": "glint_word2vec_tpu",
@@ -157,27 +194,33 @@ def save_model(
             "vector_size": int(syn0.shape[1]),
             "config": config.to_dict(auto_markers=False),
             "train_state": (train_state or TrainState(finished=True)).to_dict(),
+            "digests": digests,
         }
-        with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
+        with open(stage("metadata.json"), "w", encoding="utf-8") as f:
             json.dump(meta, f, indent=2)
+        faults.crash_point("save:staged")
         old = None
         if os.path.exists(path):
             old = path + f".old-{os.getpid()}"
             os.rename(path, old)
+        faults.crash_point("save:swap")  # the torn window: path absent, old+tmp live
         os.rename(tmp, path)
         if old is not None:
             shutil.rmtree(old)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    faults.corrupt_checkpoint(path)
 
 
-def _write_array_shards(dirpath: str, arr) -> None:
+def _write_array_shards(dirpath: str, arr) -> Dict[str, str]:
     """Write the row ranges THIS process owns (replica 0 only) as individual .npy
     files. ``arr`` is a (possibly multi-process) row-sharded jax.Array; no full-array
     host materialization happens — each shard's ``.data`` is device-local. The
-    filenames carry the row ranges; readers list the directory (no manifest)."""
+    filenames carry the row ranges; readers list the directory (no manifest).
+    Returns {checkpoint-relative path: sha256} for the files this process wrote."""
     os.makedirs(dirpath, exist_ok=True)
+    digests: Dict[str, str] = {}
     for sh in arr.addressable_shards:
         if sh.replica_id != 0:
             continue  # rows replicated over the data axis: first replica writes
@@ -191,6 +234,9 @@ def _write_array_shards(dirpath: str, arr) -> None:
                 f"column slice {cols} — use the dense layout for other shardings")
         fname = f"rows-{start:010d}-{stop:010d}.npy"
         np.save(os.path.join(dirpath, fname), np.asarray(sh.data))
+        rel = f"{os.path.basename(dirpath)}/{fname}"
+        digests[rel] = _sha256_file(os.path.join(dirpath, fname))
+    return digests
 
 
 def save_model_sharded(
@@ -244,17 +290,33 @@ def save_model_sharded(
     try:
         # shard lists are NOT collected into metadata: readers list the directory, and
         # the filenames carry the row ranges (a cross-process reduce would buy nothing)
-        _write_array_shards(os.path.join(tmp, "syn0.shards"), syn0)
+        digests = _write_array_shards(os.path.join(tmp, "syn0.shards"), syn0)
         if syn1 is not None:
-            _write_array_shards(os.path.join(tmp, "syn1.shards"), syn1)
+            digests.update(
+                _write_array_shards(os.path.join(tmp, "syn1.shards"), syn1))
+        # per-process digest sidecars ride the shared filesystem (the same
+        # contract the shard files themselves rely on); process 0 merges them
+        # into metadata after the write barrier — cheaper and simpler than
+        # allgathering variable-length digest maps through the device mesh
+        sidecar = os.path.join(tmp, f".digests-{jax.process_index()}.json")
+        with open(sidecar, "w", encoding="utf-8") as f:
+            json.dump(digests, f)
+        faults.crash_point("save:arrays-written")
         if multi:
             multihost_utils.sync_global_devices("glint-ckpt-written")
         if jax.process_index() == 0:
+            for name in sorted(os.listdir(tmp)):
+                if name.startswith(".digests-"):
+                    with open(os.path.join(tmp, name), encoding="utf-8") as f:
+                        digests.update(json.load(f))
+                    os.unlink(os.path.join(tmp, name))
             with open(os.path.join(tmp, "words"), "w", encoding="utf-8") as f:
                 for w in words:
                     f.write(w + "\n")
             np.save(os.path.join(tmp, "counts.npy"),
                     np.asarray(counts, dtype=np.int64))
+            digests["words"] = _sha256_file(os.path.join(tmp, "words"))
+            digests["counts.npy"] = _sha256_file(os.path.join(tmp, "counts.npy"))
             meta = {
                 "format_version": _format_version(SHARDED_FORMAT_VERSION,
                                                   train_state),
@@ -268,15 +330,18 @@ def save_model_sharded(
                 "padded_dim": int(syn0.shape[1]),
                 "config": config.to_dict(auto_markers=False),
                 "train_state": (train_state or TrainState(finished=True)).to_dict(),
+                "digests": digests,
             }
             with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
                 json.dump(meta, f, indent=2)
+            faults.crash_point("save:staged")
             old = None
             if os.path.exists(path):
                 old = path + ".old-swap"
                 if os.path.exists(old):
                     shutil.rmtree(old)
                 os.rename(path, old)
+            faults.crash_point("save:swap")
             os.rename(tmp, path)
             if old is not None:
                 shutil.rmtree(old)
@@ -286,6 +351,8 @@ def save_model_sharded(
         if jax.process_index() == 0:
             shutil.rmtree(tmp, ignore_errors=True)
         raise
+    if jax.process_index() == 0:
+        faults.corrupt_checkpoint(path)
 
 
 class ShardedMatrixReader:
@@ -350,12 +417,17 @@ class ShardedMatrixReader:
 
 
 def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
-                          dtype=np.float32):
+                          dtype=np.float32, verify: bool = False):
     """Stream a row-shards checkpoint straight onto a target mesh (which may differ
     from the one that wrote it — the reference's load-onto-new-PS-topology path,
     mllib:696-725): each device's row block is read from the mmap'd shard files by a
     ``make_array_from_callback`` callback, zero-padded to the target padded shape.
-    Returns (syn0, syn1) as global jax.Arrays; syn1 is None if not saved."""
+    Returns (syn0, syn1) as global jax.Arrays; syn1 is None if not saved.
+
+    ``verify=True`` checks the recorded shard digests first — one extra
+    sequential read of every shard file, so it is off by default on this
+    streaming path (the 10M-row north star); recovery flows that just survived
+    a crash should pass True or call :func:`verify_checkpoint` themselves."""
     import jax
 
     meta_path = os.path.join(path, "metadata.json")
@@ -363,6 +435,8 @@ def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
         meta = json.load(f)
     if meta.get("layout") != "row-shards":
         raise ValueError(f"{path!r} is not a row-shards checkpoint")
+    if verify:
+        _verify_digests(path, meta)
     V, Dr = meta["vocab_size"], meta["vector_size"]
 
     def make(name: str):
@@ -388,6 +462,149 @@ def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
             (padded_vocab, padded_dim), plan.embedding, cb)
 
     return make("syn0"), make("syn1")
+
+
+def _verify_digests(path: str, meta: Dict[str, Any]) -> None:
+    """Check every recorded SHA-256 digest against the on-disk bytes.
+    Checkpoints written before the digest map existed pass vacuously."""
+    digests = meta.get("digests") or {}
+    for rel, want in sorted(digests.items()):
+        fp = os.path.join(path, rel.replace("/", os.sep))
+        if not os.path.exists(fp):
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r}: {rel!r} is recorded in the digest map "
+                f"but missing on disk — torn or partially deleted checkpoint")
+        got = _sha256_file(fp)
+        if got != want:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r}: {rel!r} content digest {got[:12]}… does "
+                f"not match the recorded {want[:12]}… — corrupt (bit rot, torn "
+                f"write, or hand-edited); refusing to load it")
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Integrity audit of one checkpoint directory without loading matrices
+    into device memory: metadata parses, the format version is readable, every
+    required data file for the layout exists, shard spans are gapless, and all
+    recorded digests match the bytes on disk. Returns the parsed metadata.
+    Raises :class:`CheckpointCorruptError` (or ``FileNotFoundError`` when no
+    metadata exists at all)."""
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no metadata.json under {path!r}")
+    try:
+        with open(meta_path, "r", encoding="utf-8") as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r}: metadata.json unreadable ({e})") from e
+    version = meta.get("format_version")
+    if version not in _READABLE_VERSIONS:
+        raise CheckpointCorruptError(
+            f"checkpoint {path!r}: unsupported format_version {version}")
+    required = ["words", "counts.npy"]
+    if meta.get("layout") == "row-shards":
+        shard_dirs = ["syn0.shards"]
+        if os.path.isdir(os.path.join(path, "syn1.shards")):
+            shard_dirs.append("syn1.shards")
+        for dirname in shard_dirs:
+            try:
+                ShardedMatrixReader(os.path.join(path, dirname))
+            except (OSError, ValueError) as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path!r}: {dirname} unreadable ({e})") from e
+    else:
+        required.append("syn0.npy")
+    for name in required:
+        if not os.path.exists(os.path.join(path, name)):
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r}: required file {name!r} missing — "
+                f"partial or torn checkpoint")
+    _verify_digests(path, meta)
+    return meta
+
+
+def load_latest_valid(directory: str, reclaim: bool = True) -> str:
+    """Recovery entry point: scan ``directory`` for checkpoint directories and
+    return the path of the newest one that passes :func:`verify_checkpoint`.
+
+    "Newest" orders by the recorded train progress (global_step, then
+    words_processed), falling back to mtime — progress is what a resume cares
+    about, and mtimes lie across filesystems and restores.
+
+    Interrupted-save debris is reclaimed along the way (``reclaim=True``):
+
+    - ``.\\*.tmp-\\*`` staging directories (never swapped into place) are deleted
+      outright — even a complete one was never committed.
+    - ``\\*.old-\\*`` directories (the previous checkpoint, renamed aside during
+      the swap window) are *candidates*: if one is the newest verifiable state
+      — the SIGKILL-between-renames case, where the live path vanished — it is
+      renamed back into place and its path returned; superseded or corrupt
+      ones are deleted.
+
+    With ``reclaim=True`` this is a RECOVERY operation for a dead writer: it
+    deletes staging directories and renames swap debris, so it must NOT race a
+    live saver (it would destroy an in-flight save). Readers that may overlap
+    a running trainer — a serving process polling the directory — pass
+    ``reclaim=False``: nothing is touched, and a winning ``*.old-*`` candidate
+    is returned at its debris path instead of being renamed back.
+
+    Raises ``FileNotFoundError`` when nothing under ``directory`` verifies."""
+    try:
+        entries = sorted(os.listdir(directory))
+    except OSError as e:
+        raise FileNotFoundError(
+            f"cannot scan checkpoint directory {directory!r}: {e}") from e
+    candidates: List[tuple] = []  # (kind, name, path)
+    for name in entries:
+        p = os.path.join(directory, name)
+        if not os.path.isdir(p):
+            continue
+        if ".tmp-" in name:
+            if reclaim:
+                logger.info("reclaiming interrupted-save staging dir %s", p)
+                shutil.rmtree(p, ignore_errors=True)
+            continue
+        kind = "old" if ".old-" in name else "normal"
+        candidates.append((kind, name, p))
+    best = None  # (sort_key, kind, name, path)
+    for kind, name, p in candidates:
+        try:
+            meta = verify_checkpoint(p)
+        except (FileNotFoundError, CheckpointCorruptError, ValueError) as e:
+            logger.warning("skipping unverifiable checkpoint %s: %s", p, e)
+            continue
+        ts = meta.get("train_state") or {}
+        key = (int(ts.get("global_step") or 0),
+               int(ts.get("words_processed") or 0),
+               1 if kind == "normal" else 0,
+               os.path.getmtime(p))
+        if best is None or key > best[0]:
+            best = (key, kind, name, p)
+    if best is None:
+        raise FileNotFoundError(
+            f"no verifiable checkpoint under {directory!r} "
+            f"({len(candidates)} candidate(s) scanned)")
+    _, kind, name, p = best
+    if not reclaim:
+        return p
+    if kind == "old":
+        # the swap was interrupted after the previous checkpoint was renamed
+        # aside: restore it to its base name so resume paths see a normal
+        # checkpoint (anything sitting at the base name failed verification,
+        # or it would have outranked this debris)
+        base = os.path.join(directory, name.split(".old-")[0])
+        if os.path.exists(base):
+            shutil.rmtree(base)
+        os.rename(p, base)
+        logger.warning("recovered checkpoint %s from interrupted-save "
+                       "debris %s", base, name)
+        p = base
+    for kind2, _, p2 in candidates:
+        if kind2 == "old" and p2 != best[3] and os.path.exists(p2):
+            logger.info("reclaiming superseded swap debris %s", p2)
+            shutil.rmtree(p2, ignore_errors=True)
+    return p
 
 
 def load_model_header(path: str) -> Dict[str, Any]:
@@ -422,16 +639,27 @@ def load_model_header(path: str) -> Dict[str, Any]:
     }
 
 
-def load_model(path: str, header: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+def load_model(path: str, header: Optional[Dict[str, Any]] = None,
+               verify: bool = True) -> Dict[str, Any]:
     """Read a saved model directory. Returns dict with words, counts, syn0, syn1 (may be
     None), config, train_state. Mirrors the reference's load contract (mllib:710-725:
     read /words in row order, load matrix shards, rebuild model).
 
     ``header``: a prior :func:`load_model_header` result to reuse — callers that
     already read it (to check the layout) pass it through so the words sidecar and
-    counts are not parsed twice."""
+    counts are not parsed twice.
+
+    ``verify`` (default True): check every file against the SHA-256 digests the
+    writer recorded — a bit-flipped or torn checkpoint raises
+    :class:`CheckpointCorruptError` instead of silently loading garbage rows.
+    Costs one extra sequential read of the files; this full-materialization
+    path is host-RAM-bound anyway (pre-digest checkpoints pass vacuously)."""
     if header is None:
         header = load_model_header(path)
+    if verify:
+        meta_path = os.path.join(path, "metadata.json")
+        with open(meta_path, "r", encoding="utf-8") as f:
+            _verify_digests(path, json.load(f))
     words = header["words"]
     if header["layout"] == "row-shards":
         V, Dr = header["vocab_size"], header["vector_size"]

@@ -1,0 +1,254 @@
+"""Deterministic fault injection + bounded-retry primitives for the runtime.
+
+The reference system survives worker loss because Spark re-executes partitions
+against Hogwild parameter servers (SURVEY §5); this single-program port has no
+scheduler above it, so its fault tolerance (checkpoint integrity, non-finite
+guardrails, retrying ingest — train/checkpoint.py, train/trainer.py, data/) must
+be testable without flaky kill-timing. This module is the single switchboard the
+runtime consults at each fault point, so a test or the chaos runner
+(tools/chaos_run.py) can script "crash during the second checkpoint swap" or
+"fail the first two ingest reads" deterministically.
+
+Fault points (env-driven for subprocess tests, :func:`configure` for in-process
+tests; all off by default and zero-cost when off):
+
+- ``GLINT_FAULT_CRASH_AT_STEP=N`` — SIGKILL this process at the end of the
+  dispatch round that reaches global step >= N (trainer._finish_round).
+- ``GLINT_FAULT_CRASH_POINT=name[@k]`` — SIGKILL at the k-th (default first)
+  pass through the named crash point. Checkpoint saves expose
+  ``save:arrays-written`` (data files staged, no metadata yet),
+  ``save:staged`` (staging dir complete, swap not started) and ``save:swap``
+  (previous checkpoint renamed aside, replacement not yet in place — the torn
+  window the SIGKILL recovery test exercises).
+- ``GLINT_FAULT_CORRUPT_CKPT_BYTES=N`` — after every completed save, flip N
+  bytes of one array file (deterministic offsets derived from the file bytes),
+  simulating bit rot / torn writes that the digest verification must catch.
+- ``GLINT_FAULT_FAIL_INGEST_FIRST_N=N`` — the first N guarded ingest I/O
+  attempts raise :class:`InjectedFault` (an ``OSError``), exercising the
+  bounded-backoff retry wrappers in ``data/``.
+- ``GLINT_FAULT_NAN_AT_STEP=N`` — the trainer poisons one param entry with NaN
+  at the first round whose global step reaches N (once), exercising the
+  non-finite guardrail's halt/rollback policies.
+
+SIGKILL (not ``sys.exit``) is deliberate: no ``finally`` blocks, no atexit, no
+flushes — the same failure surface as an OOM-kill or preemption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+T = TypeVar("T")
+
+
+class InjectedFault(OSError):
+    """A scripted fault from this module — an OSError so the production retry
+    paths treat it exactly like a real transient I/O failure."""
+
+
+class NonFiniteParamsError(RuntimeError):
+    """Raised by the trainer's non-finite guardrail under ``policy="halt"`` (or
+    when ``rollback`` has no snapshot left / exhausted its retry budget)."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One scripted fault schedule. All zeros/empties = no faults."""
+
+    crash_at_step: int = 0
+    crash_point: str = ""          # e.g. "save:swap" or "save:swap@2"
+    corrupt_checkpoint_bytes: int = 0
+    fail_ingest_first_n: int = 0
+    nan_at_step: int = 0
+
+
+_override: Optional[FaultPlan] = None
+_counters: dict = {}
+
+
+def configure(**kwargs) -> FaultPlan:
+    """Install an in-process fault plan (tests); overrides the env until
+    :func:`reset`. Resets all hit counters."""
+    global _override
+    _override = FaultPlan(**kwargs)
+    _counters.clear()
+    return _override
+
+
+def reset() -> None:
+    """Clear any in-process plan and all hit counters (env vars still apply)."""
+    global _override
+    _override = None
+    _counters.clear()
+
+
+def _env_int(name: str) -> int:
+    v = os.environ.get(name, "")
+    try:
+        return int(v) if v else 0
+    except ValueError:
+        logger.warning("ignoring non-integer %s=%r", name, v)
+        return 0
+
+
+def active_plan() -> FaultPlan:
+    """The effective plan: the in-process override if set, else the env (read
+    fresh each call — fault consults sit on cold paths, and tests flip env
+    vars mid-process)."""
+    if _override is not None:
+        return _override
+    return FaultPlan(
+        crash_at_step=_env_int("GLINT_FAULT_CRASH_AT_STEP"),
+        crash_point=os.environ.get("GLINT_FAULT_CRASH_POINT", ""),
+        corrupt_checkpoint_bytes=_env_int("GLINT_FAULT_CORRUPT_CKPT_BYTES"),
+        fail_ingest_first_n=_env_int("GLINT_FAULT_FAIL_INGEST_FIRST_N"),
+        nan_at_step=_env_int("GLINT_FAULT_NAN_AT_STEP"),
+    )
+
+
+def _crash_now(reason: str) -> None:
+    # stderr directly (not logging): handlers may buffer, and the point of the
+    # exercise is that nothing after this line runs
+    os.write(2, f"[glint-fault] SIGKILL: {reason}\n".encode())
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def crash_at_step(global_step: int) -> None:
+    """Trainer hook: die when the run reaches the scripted global step."""
+    p = active_plan()
+    if p.crash_at_step and global_step >= p.crash_at_step:
+        _crash_now(f"crash_at_step {p.crash_at_step} (global_step {global_step})")
+
+
+def _parse_point(spec: str) -> Tuple[str, int]:
+    if "@" in spec:
+        name, _, nth = spec.rpartition("@")
+        try:
+            return name, max(1, int(nth))
+        except ValueError:
+            return spec, 1
+    return spec, 1
+
+
+def crash_point(name: str) -> None:
+    """Named crash point (e.g. inside checkpoint save). Dies on the k-th pass
+    when the plan scripts ``name@k`` (default k=1)."""
+    p = active_plan()
+    if not p.crash_point:
+        return
+    want, nth = _parse_point(p.crash_point)
+    if want != name:
+        return
+    hits = _counters.get(("point", name), 0) + 1
+    _counters[("point", name)] = hits
+    if hits >= nth:
+        _crash_now(f"crash_point {name} (hit {hits})")
+
+
+def take_nan_injection(global_step: int) -> bool:
+    """Trainer hook: True exactly once, at the first round whose global step
+    reaches the scripted ``nan_at_step``."""
+    p = active_plan()
+    if not p.nan_at_step or global_step < p.nan_at_step:
+        return False
+    if _counters.get("nan_done"):
+        return False
+    _counters["nan_done"] = True
+    logger.warning("injecting NaN into params at global step %d (scripted "
+                   "nan_at_step=%d)", global_step, p.nan_at_step)
+    return True
+
+
+def maybe_fail_ingest(what: str) -> None:
+    """Ingest-I/O hook: raise :class:`InjectedFault` for the first
+    ``fail_ingest_first_n`` guarded attempts."""
+    p = active_plan()
+    if not p.fail_ingest_first_n:
+        return
+    n = _counters.get("ingest", 0)
+    if n >= p.fail_ingest_first_n:
+        return
+    _counters["ingest"] = n + 1
+    raise InjectedFault(
+        f"injected ingest fault {n + 1}/{p.fail_ingest_first_n}: {what}")
+
+
+def corrupt_checkpoint(path: str) -> None:
+    """Post-save hook: flip ``corrupt_checkpoint_bytes`` bytes of one array
+    file under the completed checkpoint at ``path`` — deterministic offsets (a
+    function of the file size), so a scripted corruption is reproducible."""
+    p = active_plan()
+    n = p.corrupt_checkpoint_bytes
+    if not n:
+        return
+    target = None
+    for cand in ("syn0.npy", "syn1.npy", "counts.npy"):
+        if os.path.exists(os.path.join(path, cand)):
+            target = os.path.join(path, cand)
+            break
+    if target is None:
+        shards = os.path.join(path, "syn0.shards")
+        if os.path.isdir(shards):
+            names = sorted(f for f in os.listdir(shards) if f.endswith(".npy"))
+            if names:
+                target = os.path.join(shards, names[0])
+    if target is None:
+        logger.warning("corrupt_checkpoint: no array file under %r", path)
+        return
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        for i in range(n):
+            # land inside the array payload (skip the ~128-byte .npy header)
+            off = 128 + (size // 3 + i * 7919) % max(size - 129, 1)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    logger.warning("corrupt_checkpoint: flipped %d byte(s) of %s", n, target)
+
+
+def retry_io(
+    fn: Callable[[], T],
+    what: str,
+    attempts: int = 5,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+) -> T:
+    """Run ``fn`` with bounded exponential backoff — the retry contract for
+    every flaky-I/O surface in ``data/`` (corpus opens, encoded-corpus mmaps,
+    native ingest passes). Delays are deterministic (no jitter): the producers
+    these wrap are single-caller, so thundering-herd spreading buys nothing and
+    determinism keeps the fault tests exact. Permanent errors (missing path,
+    permissions, disk full, read-only fs) fail fast — retrying those burns the
+    whole backoff budget, and for restart-from-scratch encode attempts re-runs
+    a potentially multi-GB pass, with no chance of success. Re-raises the last
+    error once the attempt budget is spent."""
+    import errno
+    permanent_types = (FileNotFoundError, PermissionError, IsADirectoryError,
+                       NotADirectoryError)
+    permanent_errnos = (errno.ENOENT, errno.EACCES, errno.EISDIR,
+                        errno.ENOSPC, errno.EROFS)
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — cold path
+            last = e
+            if (isinstance(e, permanent_types)
+                    or getattr(e, "errno", None) in permanent_errnos
+                    or i == attempts - 1):
+                break
+            delay = min(base_delay * (2.0 ** i), max_delay)
+            logger.warning("%s failed (%s); retry %d/%d in %.2fs",
+                           what, e, i + 1, attempts - 1, delay)
+            time.sleep(delay)
+    assert last is not None
+    raise last

@@ -45,7 +45,9 @@ from glint_word2vec_tpu.ops.sgns import (
 from glint_word2vec_tpu.parallel.distributed import put_global
 from glint_word2vec_tpu.parallel.mesh import (
     MeshPlan, make_mesh, pad_dim_to_lanes, pad_vocab_for_sharding)
+from glint_word2vec_tpu.train import faults
 from glint_word2vec_tpu.train.checkpoint import TrainState, save_model
+from glint_word2vec_tpu.train.faults import NonFiniteParamsError
 
 logger = logging.getLogger("glint_word2vec_tpu")
 
@@ -317,6 +319,15 @@ class Trainer:
         self.global_step = self.state.global_step
         self.pairs_trained = 0.0  # real (unmasked) pairs dispatched over this run
         self.heartbeats: List[HeartbeatRecord] = []
+        # non-finite guardrail state (config.nonfinite_policy): a ring of the
+        # last K good device-resident param snapshots plus small jitted probes,
+        # all built lazily — a policy="none" run pays nothing
+        from collections import deque
+        self._snapshot_ring: "deque" = deque(maxlen=config.rollback_history)
+        self.rollbacks_performed = 0
+        self._finite_fn: Optional[Callable] = None
+        self._copy_params_fn: Optional[Callable] = None
+        self._poison_fn: Optional[Callable] = None  # scripted NaN injection
         self._step_fn = self._build_step()
         # fast twin (metrics elided) for the shared-pool paths (skip-gram and
         # CBOW): the paths whose loss side-channel is an extra full [B, pool]
@@ -1618,6 +1629,13 @@ class Trainer:
             yield chunk
 
     def _start_run_bookkeeping(self) -> None:
+        self.rollbacks_performed = 0  # max_rollbacks is a per-fit() budget
+        if (self.config.nonfinite_policy == "rollback"
+                and not self._snapshot_ring):
+            # seed the ring with the starting params so even a blowup inside
+            # the first heartbeat window has a restore point
+            self._snapshot_ring.append(
+                (self._copy_params(self.params), self.global_step))
         self.host_wait_time = 0.0      # fit() blocked on batch production (incl. the
                                        # producer's device staging when prefetching)
         self.dispatch_time = 0.0       # fit() inside (async) step dispatch; also the
@@ -1639,6 +1657,99 @@ class Trainer:
             jax.profiler.stop_trace()
             self._profiling = False
 
+    # rollback re-seed: the negative-sample stream is a pure function of
+    # (seed, global_step) — ops/prng.py — so jumping the counter far past any
+    # step the run will legitimately reach gives the retried stretch a fresh
+    # negative-sample path WITHOUT rebuilding the jitted step (the seed itself
+    # is a compile-time constant). 2^22 steps is ~275B pairs at B=64k, far
+    # beyond any single fit; repeated rollbacks jump again, so paths never
+    # overlap.
+    _ROLLBACK_STEP_JUMP = 1 << 22
+
+    def _params_finite(self) -> bool:
+        if self._finite_fn is None:
+            self._finite_fn = jax.jit(
+                lambda p: jnp.isfinite(p.syn0).all() & jnp.isfinite(p.syn1).all())
+        return bool(self._finite_fn(self.params))
+
+    def _copy_params(self, params: EmbeddingPair) -> EmbeddingPair:
+        if self._copy_params_fn is None:
+            self._copy_params_fn = jax.jit(
+                lambda p: jax.tree.map(jnp.copy, p))
+        return self._copy_params_fn(params)
+
+    def _nonfinite_diagnostic(self) -> str:
+        bad0 = int(jnp.sum(~jnp.isfinite(self.params.syn0)))
+        bad1 = int(jnp.sum(~jnp.isfinite(self.params.syn1)))
+        return (
+            f"non-finite parameters at global step {self.global_step}: "
+            f"{bad0} entries in syn0, {bad1} in syn1 (of "
+            f"{self.padded_vocab}x{self.padded_dim} each). Likely causes, in "
+            f"measured order (EVAL.md): pool-row overload "
+            f"(grow negative_pool), duplicate-overload (lower subsample_ratio "
+            f"~1e-4 or set duplicate_scaling=True), or learning rate too high "
+            f"for {self.config.param_dtype}. Set nonfinite_policy='rollback' "
+            f"to auto-recover from the last good snapshot instead of halting")
+
+    def _nonfinite_guard(self) -> None:
+        """Heartbeat-cadence finiteness guardrail (config.nonfinite_policy).
+        The probe is a separate tiny jitted reduction over the params carry,
+        fetched alongside the heartbeat's metrics fetch (which already forces
+        a device sync) — the training step functions are untouched, so the
+        fast metrics-elided twin stays elided. On a finite probe under
+        ``rollback``, the current params are snapshotted into the ring; on a
+        non-finite probe the policy decides: ``halt`` raises with a
+        diagnostic, ``rollback`` pops and restores the newest good snapshot
+        and jumps the negative-sample counter lattice so the retried stretch
+        draws different negatives (the host data stream keeps advancing — the
+        updates between the snapshot and the blowup are sacrificed, the same
+        accounting loss as resuming a checkpoint). Repeated blowups before the
+        next finite probe step back through the older ring entries; an
+        emptied ring raises."""
+        cfg = self.config
+        if self._params_finite():
+            if cfg.nonfinite_policy == "rollback":
+                self._snapshot_ring.append(
+                    (self._copy_params(self.params), self.global_step))
+            return
+        if cfg.nonfinite_policy == "halt":
+            raise NonFiniteParamsError(self._nonfinite_diagnostic())
+        if not self._snapshot_ring:
+            if self.rollbacks_performed:
+                raise NonFiniteParamsError(
+                    f"rollback ring exhausted after "
+                    f"{self.rollbacks_performed} rollback(s) — repeated "
+                    f"divergence consumed every good snapshot; this needs a "
+                    f"config change, not retries. "
+                    + self._nonfinite_diagnostic())
+            raise NonFiniteParamsError(
+                self._nonfinite_diagnostic()
+                + " (rollback requested but no good snapshot was taken yet "
+                  "— blowup before the first probe)")
+        if self.rollbacks_performed >= cfg.max_rollbacks:
+            raise NonFiniteParamsError(
+                f"giving up after {self.rollbacks_performed} rollbacks — the "
+                f"run keeps diverging; this needs a config change, not "
+                f"retries. " + self._nonfinite_diagnostic())
+        # POP the newest snapshot and restore it directly (no copy needed —
+        # the entry leaves the ring, so the next dispatch is free to donate
+        # its buffers). Popping is what makes the deeper ring entries
+        # reachable: a retry that blows up again before the next finite probe
+        # steps back to the NEXT-older snapshot instead of thrashing on the
+        # same one, and an emptied ring escalates to the halt diagnostic.
+        params, snap_step = self._snapshot_ring.pop()
+        self.params = params
+        self.rollbacks_performed += 1
+        old_step = self.global_step
+        self.global_step = max(self.global_step, snap_step) + \
+            self._ROLLBACK_STEP_JUMP
+        self.state = dc_replace(self.state, global_step=self.global_step)
+        logger.warning(
+            "non-finite params at step %d: rolled back to the snapshot from "
+            "step %d and re-seeded the negative-sample lattice (counter -> %d; "
+            "rollback %d/%d)", old_step, snap_step, self.global_step,
+            self.rollbacks_performed, self.config.max_rollbacks)
+
     def _finish_round(
         self,
         real: int,
@@ -1653,14 +1764,34 @@ class Trainer:
         """Post-dispatch bookkeeping shared by both feed modes: progress counters,
         heartbeat cadence (the reference's every-10k-words line, mllib:404-413 —
         fetching device metrics forces a sync, so it runs on a chunked cadence to keep
-        the async dispatch pipeline full), and periodic checkpointing."""
+        the async dispatch pipeline full), the non-finite guardrail + scripted fault
+        hooks (train/faults.py), and periodic checkpointing."""
         cfg = self.config
         self.global_step += real
         self._pairs_since_log += real_pairs
         self.pairs_trained += real_pairs
         self.state = dc_replace(state, global_step=self.global_step)
 
-        if self.global_step - self._last_log_step >= cfg.heartbeat_every_steps:
+        if faults.take_nan_injection(self.global_step):
+            if self._poison_fn is None:
+                self._poison_fn = jax.jit(lambda p: EmbeddingPair(
+                    p.syn0.at[0, 0].set(jnp.asarray(jnp.nan, p.syn0.dtype)),
+                    p.syn1))
+            self.params = self._poison_fn(self.params)
+        faults.crash_at_step(self.global_step)
+
+        ckpt_due = bool(checkpoint_path and checkpoint_every_steps
+                        and self.global_step % checkpoint_every_steps < real)
+        hb_due = (self.global_step - self._last_log_step
+                  >= cfg.heartbeat_every_steps)
+        if cfg.nonfinite_policy != "none" and hb_due and not ckpt_due:
+            # heartbeat-cadence probe; checkpoint rounds are covered by the
+            # guard inside save_checkpoint itself (every save — periodic AND
+            # the end-of-fit finished save — is probed exactly once, so a
+            # blown-up state never overwrites the on-disk good checkpoint)
+            self._nonfinite_guard()
+
+        if hb_due:
             now = time.perf_counter()
             pps = self._pairs_since_log / max(now - self._last_log_time, 1e-9)
             self._pairs_since_log = 0.0
@@ -1679,8 +1810,7 @@ class Trainer:
                 on_heartbeat(rec)
             self._last_log_time, self._last_log_step = now, self.global_step
 
-        if (checkpoint_path and checkpoint_every_steps
-                and self.global_step % checkpoint_every_steps < real):
+        if ckpt_due:
             self.save_checkpoint(checkpoint_path)
 
     def _fit_sharded(
@@ -1962,6 +2092,11 @@ class Trainer:
                              syn1=self.params.syn1[:V, :D])
 
     def save_checkpoint(self, path: str) -> None:
+        if self.config.nonfinite_policy != "none":
+            # every save — periodic and the finished end-of-fit one — runs the
+            # guardrail first: 'halt' refuses to replace the last good on-disk
+            # checkpoint with NaNs, 'rollback' saves the restored snapshot
+            self._nonfinite_guard()
         from glint_word2vec_tpu.parallel.distributed import is_multiprocess
         if self.config.sharded_checkpoint or is_multiprocess():
             # row-shards layout: each process writes its own rows, no host gather

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Chaos runner: drive a toy-corpus run through a scripted fault schedule
+end-to-end and verify the fault-tolerance layer holds (docs/robustness.md).
+
+Every fault is deterministic (train/faults.py) — no sleep/kill-timing races:
+
+1. **crash-resume** — a subprocess trains with periodic checkpointing and is
+   SIGKILLed *inside* the second checkpoint's swap window (the torn state: old
+   checkpoint renamed aside, replacement not yet in place). The parent recovers
+   via ``load_latest_valid`` (which reclaims the staging debris and restores
+   the renamed-aside previous checkpoint), resumes training from it, and
+   verifies the finished checkpoint's digests.
+2. **corrupt-fallback** — a newer checkpoint is saved with scripted bit-flips;
+   ``load_latest_valid`` must reject it on digest mismatch and fall back to the
+   older clean one.
+3. **nan-rollback / nan-halt** — NaN is injected into the params carry at a
+   scripted step; under ``nonfinite_policy="rollback"`` the run finishes with
+   finite embeddings, under ``"halt"`` it fails fast with a diagnostic.
+4. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
+   exponential-backoff wrapper in ``data/`` must absorb them.
+
+Usage::
+
+    python tools/chaos_run.py           # moderate sizes
+    python tools/chaos_run.py --smoke   # small + fast (wired into tier-1 tests)
+
+Exit code 0 iff every phase passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def toy_sentences(n_sentences: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return [[f"w{i}" for i in rng.integers(0, 30, 20)]
+            for _ in range(n_sentences)]
+
+
+def toy_config(policy: str = "halt"):
+    from glint_word2vec_tpu.config import Word2VecConfig
+    return Word2VecConfig(
+        vector_size=8, pairs_per_batch=128, window=3, num_iterations=2,
+        steps_per_dispatch=2, heartbeat_every_steps=2, subsample_ratio=0.0,
+        prefetch_chunks=0, seed=1, nonfinite_policy=policy)
+
+
+def _fit(sentences, cfg, **kw):
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.train.trainer import Trainer
+    vocab = build_vocab(sentences, min_count=1)
+    enc = encode_sentences(sentences, vocab, 1000)
+    trainer = Trainer(cfg, vocab)
+    trainer.fit(enc, **kw)
+    return trainer
+
+
+def worker_crash(workdir: str, n_sentences: int) -> None:
+    """The crashing training leg — launched as a subprocess with
+    GLINT_FAULT_CRASH_POINT=save:swap@2 in its env, so the first periodic save
+    completes and the second dies mid-swap. Never returns normally."""
+    _fit(toy_sentences(n_sentences), toy_config(),
+         checkpoint_path=os.path.join(workdir, "ck"),
+         checkpoint_every_steps=2)
+    print("WORKER SURVIVED (fault did not fire)", flush=True)
+    sys.exit(3)
+
+
+def phase_crash_resume(workdir: str, n_sentences: int) -> str:
+    from glint_word2vec_tpu.models.estimator import Word2Vec
+    from glint_word2vec_tpu.train.checkpoint import (
+        load_latest_valid, verify_checkpoint)
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               GLINT_FAULT_CRASH_POINT="save:swap@2")
+    rc = subprocess.call(
+        [sys.executable, os.path.abspath(__file__), "--worker", "crash",
+         "--workdir", workdir, "--sentences", str(n_sentences)],
+        env=env)
+    if rc not in (-9, 137):
+        return f"worker exited {rc}, expected SIGKILL (-9/137)"
+    entries = sorted(os.listdir(workdir))
+    if not any(".old-" in e or ".tmp-" in e for e in entries):
+        return f"no interrupted-save debris found ({entries}) — fault missed"
+    ck = load_latest_valid(workdir)
+    meta = verify_checkpoint(ck)
+    step = meta["train_state"]["global_step"]
+    if meta["train_state"]["finished"] or step <= 0:
+        return f"recovered checkpoint is not a mid-run state (step {step})"
+    model = Word2Vec.resume(ck, toy_sentences(n_sentences),
+                            checkpoint_every_steps=2)
+    if not model.train_state.finished:
+        return "resumed run did not finish"
+    verify_checkpoint(ck)  # the finished save must verify too
+    if not np.isfinite(np.asarray(model.syn0)).all():
+        return "resumed run produced non-finite embeddings"
+    return ""
+
+
+def phase_corrupt_fallback(workdir: str) -> str:
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.train import faults
+    from glint_word2vec_tpu.train.checkpoint import (
+        TrainState, load_latest_valid, save_model)
+
+    words = ["a", "b", "c"]
+    counts = np.array([3, 2, 1])
+    syn0 = np.random.default_rng(0).normal(size=(3, 8)).astype(np.float32)
+    cfg = Word2VecConfig(vector_size=8)
+    save_model(os.path.join(workdir, "ck-a"), words, counts, syn0, -syn0,
+               cfg, TrainState(global_step=10))
+    faults.configure(corrupt_checkpoint_bytes=3)
+    try:
+        save_model(os.path.join(workdir, "ck-b"), words, counts, syn0, -syn0,
+                   cfg, TrainState(global_step=20))
+    finally:
+        faults.reset()
+    got = load_latest_valid(workdir)
+    if os.path.basename(got) != "ck-a":
+        return f"picked {got!r}; expected the older clean ck-a (ck-b is corrupt)"
+    return ""
+
+
+def phase_nan(policy: str) -> str:
+    from glint_word2vec_tpu.train import faults
+    from glint_word2vec_tpu.train.faults import NonFiniteParamsError
+
+    faults.configure(nan_at_step=8)
+    try:
+        trainer = _fit(toy_sentences(200, seed=2), toy_config(policy))
+    except NonFiniteParamsError as e:
+        faults.reset()
+        if policy == "halt":
+            return "" if "non-finite parameters" in str(e) else \
+                f"halt diagnostic unclear: {e}"
+        return f"rollback run raised instead of recovering: {e}"
+    finally:
+        faults.reset()
+    if policy == "halt":
+        return "halt run finished instead of raising"
+    if not np.isfinite(np.asarray(trainer.params.syn0)).all():
+        return "rollback run ended with non-finite params"
+    if trainer.rollbacks_performed < 1:
+        return "rollback run never rolled back (fault missed)"
+    return ""
+
+
+def phase_flaky_ingest(workdir: str) -> str:
+    from glint_word2vec_tpu.data.corpus import encode_corpus
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.train import faults
+
+    sents = toy_sentences(50, seed=3)
+    vocab = build_vocab(sents, min_count=1)
+    faults.configure(fail_ingest_first_n=2)
+    try:
+        enc = encode_corpus(sents, vocab, os.path.join(workdir, "enc"))
+    except OSError as e:
+        return f"retry wrapper did not absorb 2 injected faults: {e}"
+    finally:
+        faults.reset()
+    if len(enc) != len(sents):
+        return f"encoded {len(enc)} sentences, expected {len(sents)}"
+    return ""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="small corpus / fast phases (tier-1 smoke)")
+    ap.add_argument("--workdir", default="",
+                    help="working directory (default: a fresh temp dir)")
+    ap.add_argument("--worker", choices=["crash"],
+                    help="internal: run a fault-target worker leg")
+    ap.add_argument("--sentences", type=int, default=0)
+    args = ap.parse_args()
+
+    n_sentences = args.sentences or (300 if args.smoke else 1500)
+    if args.worker == "crash":
+        worker_crash(args.workdir, n_sentences)
+        return 3  # unreachable
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="glint_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    phases = [
+        ("crash-resume",
+         lambda: phase_crash_resume(os.path.join(workdir, "p1"), n_sentences)),
+        ("corrupt-fallback",
+         lambda: phase_corrupt_fallback(os.path.join(workdir, "p2"))),
+        ("nan-rollback", lambda: phase_nan("rollback")),
+        ("nan-halt", lambda: phase_nan("halt")),
+        ("flaky-ingest",
+         lambda: phase_flaky_ingest(os.path.join(workdir, "p4"))),
+    ]
+    failures = 0
+    for name, fn in phases:
+        for sub in ("p1", "p2", "p4"):
+            os.makedirs(os.path.join(workdir, sub), exist_ok=True)
+        err = fn()
+        status = "PASS" if not err else f"FAIL: {err}"
+        print(f"[chaos] {name:18s} {status}", flush=True)
+        failures += bool(err)
+    if not args.workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(f"[chaos] {'OK' if not failures else 'FAILED'} "
+          f"({len(phases) - failures}/{len(phases)} phases passed)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -300,7 +300,15 @@ def evaluate(words, emb: np.ndarray, index=None) -> dict:
     # orders of magnitude earlier); record the scale so collapsed-purity rows
     # are interpretable as blowup vs undertraining
     row_max = np.abs(emb).max(axis=1)
-    abs_max = float(row_max.max())
+    rows_inf = int(np.isinf(row_max).sum())
+    if rows_inf:
+        # bf16 saturation reached ±inf: mask the blown entries out of the
+        # scoring (an inf row would NaN-poison every cosine it touches, the
+        # same silent distortion the NaN path guards against) and clamp the
+        # telemetry to a finite float so the EVAL_RUNS.jsonl row stays strict
+        # JSON ('Infinity' is a json.dumps extension strict parsers reject)
+        emb = np.where(np.isfinite(emb), emb, 0.0).astype(emb.dtype, copy=False)
+    abs_max = float(np.minimum(row_max.max(), np.finfo(np.float32).max))
     blown = int((row_max > 100.0).sum())
     pur, margin = purity(emb)
     rnd = np.random.default_rng(1).standard_normal(
@@ -309,6 +317,7 @@ def evaluate(words, emb: np.ndarray, index=None) -> dict:
     out = {
         "purity_at_10": round(pur, 4),
         "emb_abs_max": round(abs_max, 3),
+        "rows_inf": rows_inf,
         "rows_abs_over_100": blown,
         "purity_at_10_random_baseline": round(pur0, 4),
         "cosine_margin": round(margin, 4),
