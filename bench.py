@@ -18,6 +18,10 @@ throttles the host producer):
         at EVAL-stable geometries: pool scaled to batch per the load<=600 rule the
         60M-word runs validated. f32 and bf16 storage; bf16 negative-logit chain
         (config.logits_dtype) on the bf16 row — PERF.md §4's one real lever.
+    cbow rows — scatter (shipped default) and banded (cbow_update="banded",
+        ops/cbow_banded.py) CBOW steps at the same pool list as the SGNS rows;
+        the JSON line records cbow_step_ms / cbow_banded_examples_per_sec /
+        cbow_banded_step_ms so the trajectory captures the banded win.
     step pool=64 (UNSTABLE) — the r3 headline geometry, context only: fastest
         per-step but EVAL-measured divergent at scale. Never the headline.
     V=1M scaling — the same step at a 1M-row vocabulary (~3 GB pair at f32; run at
@@ -195,10 +199,13 @@ def bench_step(counts, b: int, pool: int, dtype: str = "float32",
     return pps, mfu
 
 
-def bench_cbow_step(counts, b: int, pool: int, param_dtype: str = "bfloat16",
-                    window: int = 5) -> tuple:
-    """CBOW shared-pool step (BASELINE config 5): grouped [B, 2w] context windows,
-    hidden = masked context mean, negatives from the shared pool."""
+def bench_cbow_step(counts, b: int, pools, param_dtype: str = "bfloat16",
+                    window: int = 5) -> dict:
+    """CBOW shared-pool SCATTER step (BASELINE config 5): grouped [B, 2w] context
+    windows, hidden = masked context mean, negatives from the shared pool.
+    Benches every pool in ``pools`` (the same list the SGNS step rows use, so
+    CBOW and SGNS geometry stay comparable round to round) over one shared
+    batch/embedding setup. Returns {pool: (examples_per_sec, ms_per_step)}."""
     import jax
     import jax.numpy as jnp
     from microbench import time_chunked
@@ -215,25 +222,6 @@ def bench_cbow_step(counts, b: int, pool: int, param_dtype: str = "bfloat16",
     rng = np.random.default_rng(0)
     syn1_0 = jnp.asarray(rng.standard_normal((V, PAD_D), np.float32) * 0.05, pdt)
 
-    def chunk(params, batches, base_step, prob, alias):
-        negs = sample_negatives_hash(prob, alias, 1234, base_step, (K, pool))
-
-        def body(p, inp):
-            batch, ng = inp
-            # with_metrics=False + params-carry fetch below: the same
-            # metrics-elided production regime bench_step measures — the
-            # trainer dispatches the elided twin on the CBOW shared-pool path
-            # too, so the CBOW and SGNS step rows stay comparable
-            new_p, m = cbow_step_shared_core(
-                p, batch["centers"], batch["contexts"], batch["ctx_mask"],
-                batch["mask"], ng, jnp.float32(0.025), NEG, "exact", pdt,
-                jnp.bfloat16 if param_dtype == "bfloat16" else jnp.float32,
-                with_metrics=False)
-            return new_p, m.loss
-
-        return jax.lax.scan(body, params, (batches, negs))
-
-    f = jax.jit(chunk, donate_argnums=(0,))
     all_batches = []
     for i in range(6):
         r = np.random.default_rng(3000 + i)
@@ -246,25 +234,115 @@ def bench_cbow_step(counts, b: int, pool: int, param_dtype: str = "bfloat16",
             "mask": jnp.ones((K, b), jnp.float32),
         })
 
-    ts = []
-    for _ in range(3):
-        spc = time_chunked(
-            lambda p, bt, base: f(p, bt, base, prob, alias),
-            make_carry=lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
-            args_for_iter=lambda i: (all_batches[i % 6], np.int32(100 + i)),
-            n_lo=2, n_hi=8,
-            # loss is elided — the barrier fetch must depend on the updated
-            # params or the whole chain can be elided (same as bench_step)
-            fetch=lambda c, out: c.syn0[0, 0].astype(jnp.float32))
-        ts.append(spc / K)
-    spp = float(np.median(ts))
-    # a CBOW "example" trains ~mean(nctx) positive word-context links; report
-    # examples/s (the step unit) and the links/s equivalent for pair comparison
-    eps = b / spp
-    log(f"step cbow {param_dtype[:4]:17s} V={V:8,d} B={b:6d} pool={pool:5d}: "
-        f"{spp * 1e3:7.3f} ms/step -> {eps:13,.0f} examples/s "
-        f"(~{eps * (C + 1) / 2:,.0f} word-link/s)")
-    return eps, 0.0
+    out = {}
+    for pool in pools:
+        def chunk(params, batches, base_step, prob, alias, pool=pool):
+            negs = sample_negatives_hash(prob, alias, 1234, base_step, (K, pool))
+
+            def body(p, inp):
+                batch, ng = inp
+                # with_metrics=False + params-carry fetch below: the same
+                # metrics-elided production regime bench_step measures — the
+                # trainer dispatches the elided twin on the CBOW shared-pool
+                # path too, so the CBOW and SGNS step rows stay comparable
+                new_p, m = cbow_step_shared_core(
+                    p, batch["centers"], batch["contexts"], batch["ctx_mask"],
+                    batch["mask"], ng, jnp.float32(0.025), NEG, "exact", pdt,
+                    jnp.bfloat16 if param_dtype == "bfloat16" else jnp.float32,
+                    with_metrics=False)
+                return new_p, m.loss
+
+            return jax.lax.scan(body, params, (batches, negs))
+
+        f = jax.jit(chunk, donate_argnums=(0,))
+        ts = []
+        for _ in range(3):
+            spc = time_chunked(
+                lambda p, bt, base: f(p, bt, base, prob, alias),
+                make_carry=lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
+                args_for_iter=lambda i: (all_batches[i % 6], np.int32(100 + i)),
+                n_lo=2, n_hi=8,
+                # loss is elided — the barrier fetch must depend on the updated
+                # params or the whole chain can be elided (same as bench_step)
+                fetch=lambda c, out: c.syn0[0, 0].astype(jnp.float32))
+            ts.append(spc / K)
+        spp = float(np.median(ts))
+        # a CBOW "example" trains ~mean(nctx) positive word-context links;
+        # report examples/s (the step unit) and links/s for pair comparison
+        eps = b / spp
+        log(f"step cbow scatter {param_dtype[:4]:9s} V={V:8,d} B={b:6d} "
+            f"pool={pool:5d}: {spp * 1e3:7.3f} ms/step -> {eps:13,.0f} "
+            f"examples/s (~{eps * (C + 1) / 2:,.0f} word-link/s)")
+        out[pool] = (eps, spp * 1e3)
+    return out
+
+
+def bench_cbow_banded_step(counts, b: int, pools, param_dtype: str = "bfloat16",
+                           window: int = 5) -> dict:
+    """Banded CBOW step (config.cbow_update="banded", ops/cbow_banded.py):
+    sentence-contiguous halo token blocks, window intervals derived on device
+    from the hash lattice, context traffic via prefix sums — ~B update rows
+    instead of B·C. Trainer-shaped chunk (scan + hash-PRNG negatives +
+    metrics-elided), same pool list as the scatter row. Examples/s counts the
+    REAL examples trained (~(w−1)/w of the B core slots; the scatter row's
+    batches are dense, so the two rows are comparable on examples/s, not
+    ms/step). Returns {pool: (examples_per_sec, ms_per_step)}."""
+    import jax
+    import jax.numpy as jnp
+    from cbow_feed import make_banded_chunk, pack_banded_feeds
+    from microbench import time_chunked
+
+    from glint_word2vec_tpu.data.hashrng import (
+        STREAM_WINDOW, hash_mod_at, stream_base)
+    from glint_word2vec_tpu.ops.sampler import build_alias_table
+    from glint_word2vec_tpu.ops.sgns import EmbeddingPair, init_embeddings
+
+    H = window
+    T = b + 2 * H
+    n_sets = 6
+    table = build_alias_table(counts)
+    prob, alias = table.prob, table.alias
+    pdt = jnp.dtype(param_dtype)
+    ldt = jnp.bfloat16 if param_dtype == "bfloat16" else jnp.float32
+    syn0_0 = init_embeddings(V, PAD_D, jax.random.key(0)).syn0.astype(pdt)
+    rng = np.random.default_rng(0)
+    syn1_0 = jnp.asarray(rng.standard_normal((V, PAD_D), np.float32) * 0.05, pdt)
+
+    # one synthetic kept-token stream with the corpus's frequency profile,
+    # 40-token sentences, cut into halo blocks exactly like the trainer feed
+    stream_len = n_sets * K * b + 2 * H
+    toks = _zipf_indices(rng, stream_len).astype(np.int32)
+    starts = np.zeros(stream_len, bool)
+    starts[::40] = True
+    win_base = stream_base(1234, STREAM_WINDOW, 1, 0)
+    feeds = pack_banded_feeds(toks, starts, T, H, n_sets, K)
+    # real examples per step: live window draws among the core tokens
+    bdraw = hash_mod_at(
+        win_base, np.arange(n_sets * K * b, dtype=np.uint64), window)
+    live_rate = float((bdraw >= 1).mean())  # boundary clipping ~negligible @40
+    real_per_step = b * live_rate
+
+    out = {}
+    for pool in pools:
+        f = jax.jit(make_banded_chunk(window, pool, NEG, pdt, ldt,
+                                      win_base, K),
+                    donate_argnums=(0,))
+        ts = []
+        for _ in range(3):
+            spc = time_chunked(
+                lambda p, bt, base: f(p, bt, base, prob, alias),
+                make_carry=lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
+                args_for_iter=lambda i: (feeds[i % n_sets], np.int32(100 + i)),
+                n_lo=2, n_hi=8,
+                fetch=lambda c, out: c.syn0[0, 0].astype(jnp.float32))
+            ts.append(spc / K)
+        spp = float(np.median(ts))
+        eps = real_per_step / spp
+        log(f"step cbow banded  {param_dtype[:4]:9s} V={V:8,d} B={b:6d} "
+            f"pool={pool:5d}: {spp * 1e3:7.3f} ms/step -> {eps:13,.0f} "
+            f"examples/s ({real_per_step:,.0f} real ex/step)")
+        out[pool] = (eps, spp * 1e3)
+    return out
 
 
 _E2E_CORPUS = None
@@ -457,11 +535,20 @@ def main() -> None:
     rows["bf16_p1024"] = bench_step(counts, B_MAIN, 1024, dtype="bfloat16",
                                     param_dtype="bfloat16",
                                     logits_dtype="bfloat16")
-    cbow_eps = None
+    # CBOW rows at the same pool list as the SGNS step rows (comparable
+    # geometry round to round): scatter (shipped default) and banded
+    # (cbow_update="banded" — the ISSUE-2 prefix-sum path; step_ab.py --cbow
+    # is the same-session interleaved A/B of the two)
+    cbow_pools = (E2E_POOL, 1024)
+    cbow_rows, cbow_banded_rows = {}, {}
     try:
-        cbow_eps, _ = bench_cbow_step(counts, B_MAIN, E2E_POOL)
+        cbow_rows = bench_cbow_step(counts, B_MAIN, cbow_pools)
     except Exception as e:
-        log(f"cbow step row failed: {type(e).__name__}: {e}")
+        log(f"cbow step rows failed: {type(e).__name__}: {e}")
+    try:
+        cbow_banded_rows = bench_cbow_banded_step(counts, B_MAIN, cbow_pools)
+    except Exception as e:
+        log(f"cbow banded step rows failed: {type(e).__name__}: {e}")
     # frontier context ONLY: EVAL-measured divergent at training scale
     try:
         bench_step(counts, B_MAIN, 64, label_extra=" [UNSTABLE @64]")
@@ -517,7 +604,15 @@ def main() -> None:
         "v1m_step_pairs_per_sec": (round(scale["step_bf16_pairs_per_sec"])
                                    if "step_bf16_pairs_per_sec" in scale
                                    else None),
-        "cbow_examples_per_sec": round(cbow_eps) if cbow_eps else None,
+        "cbow_examples_per_sec": (round(cbow_rows[E2E_POOL][0])
+                                  if E2E_POOL in cbow_rows else None),
+        "cbow_step_ms": (round(cbow_rows[E2E_POOL][1], 3)
+                         if E2E_POOL in cbow_rows else None),
+        "cbow_banded_examples_per_sec": (
+            round(cbow_banded_rows[E2E_POOL][0])
+            if E2E_POOL in cbow_banded_rows else None),
+        "cbow_banded_step_ms": (round(cbow_banded_rows[E2E_POOL][1], 3)
+                                if E2E_POOL in cbow_banded_rows else None),
     }
     print(json.dumps(result))
 

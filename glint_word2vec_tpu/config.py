@@ -159,6 +159,25 @@ class Word2VecConfig:
                                       # rows, no host gather — G9 analog); forced on
                                       # for multi-process runs
     cbow: bool = False              # CBOW variant (context-mean → center) instead of skip-gram
+    cbow_update: str = "scatter"    # CBOW step formulation (cbow=True only):
+                                    # "scatter" (default): grouped [B, 2·window]
+                                    # context batches, gather/scatter B·C syn0
+                                    # rows per step (ops/sgns.cbow_step_*). The
+                                    # reference formulation; required for
+                                    # duplicate_scaling=True and the only one
+                                    # multi-feed-agnostic (host pair feed).
+                                    # "banded": sentence-contiguous token-block
+                                    # feed + prefix-sum interval accumulation
+                                    # (ops/cbow_banded.py) — ~B context rows
+                                    # instead of B·C, projected ≥2× examples/s
+                                    # at the headline geometry (PERF.md §9).
+                                    # Identical update math (float64-equivalence
+                                    # tested); needs the shared-pool estimator
+                                    # (negative_pool > 0), window ≥ 2, and no
+                                    # duplicate_scaling — unsupported combos
+                                    # are refused at construction, never
+                                    # silently downgraded. Stays opt-in until
+                                    # EVAL evidence lands (acceptance rule)
     shuffle: bool = True            # shuffle sentence order each iteration (reference order is
                                     # whatever repartition() produced, i.e. arbitrary; mllib:345)
 
@@ -305,11 +324,73 @@ class Word2VecConfig:
         if self.num_model_shards <= 0:
             raise ValueError(
                 f"num_model_shards must be positive but got {self.num_model_shards}")
+        # --- CBOW update-path selection matrix (trainer._build_step has the
+        # dispatch-side twin of this table). Every unsupported combination is
+        # an ERROR here, not a silent fallback:
+        #   banded  × duplicate_scaling → refuse (mean semantics are
+        #       per-materialized-context-set; only the scatter path has them)
+        #   banded  × cbow=False        → refuse (knob is meaningless)
+        #   banded  × negative_pool=0   → refuse (banded is built on the
+        #       shared-pool estimator; per-example pools would re-create the
+        #       [B, n, D] row traffic the path exists to remove)
+        #   banded  × use_pallas        → refuse (pallas step is SGNS-only)
+        #   banded  × tokens_per_step   → refuse (banded derives its block size
+        #       from pairs_per_batch + window; the knob is device_pairgen's)
+        #   banded  × window=1          → refuse (legacy window b=nextInt(1)=0
+        #       yields no contexts at all — same rule as device_pairgen)
+        #   scatter × duplicate_scaling → per-example negatives
+        #       (explicit negative_pool>0 alongside it is refused below;
+        #       an AUTO pool resolves to 0)
+        if self.cbow_update not in ("scatter", "banded"):
+            raise ValueError(
+                f"cbow_update must be 'scatter' or 'banded' "
+                f"but got {self.cbow_update!r}")
+        if self.cbow_update == "banded":
+            if not self.cbow:
+                raise ValueError(
+                    "cbow_update='banded' requires cbow=True — the knob "
+                    "selects the CBOW step formulation")
+            if self.duplicate_scaling:
+                raise ValueError(
+                    "cbow_update='banded' does not support "
+                    "duplicate_scaling=True: mean-update semantics are only "
+                    "implemented on the scatter path (its per-context-set "
+                    "occurrence counts have no banded form) — use "
+                    "cbow_update='scatter'")
+            if self.use_pallas:
+                raise ValueError(
+                    "cbow_update='banded' is an XLA path; use_pallas=True "
+                    "(the fused SGNS kernel) does not apply to CBOW")
+            if self.negative_pool == 0:
+                raise ValueError(
+                    "cbow_update='banded' requires the shared-pool estimator "
+                    "(negative_pool > 0, or -1 for auto); per-example "
+                    "negatives (negative_pool=0) are scatter-path only")
+            if self.tokens_per_step:
+                raise ValueError(
+                    "cbow_update='banded' derives its token-block size from "
+                    "pairs_per_batch + window; tokens_per_step is the "
+                    "device_pairgen knob — leave it 0")
+            if self.window < 2:
+                raise ValueError(
+                    "cbow_update='banded' with window=1 emits no contexts at "
+                    "all under the reference's legacy asymmetric window "
+                    "(b = nextInt(1) = 0 always) — use window >= 2")
+        if (self.cbow and self.duplicate_scaling and self.negative_pool > 0):
+            raise ValueError(
+                "CBOW with duplicate_scaling=True implements mean semantics "
+                "per-example only; an explicit negative_pool > 0 would be "
+                "silently ignored — set negative_pool=0 (or -1 for auto, "
+                "which resolves to 0 here)")
         # remembered so replace() re-derives the pool when the batch geometry
         # changes (a resolved auto pool must not stick to a new pairs_per_batch)
         self._auto_pool = self.negative_pool == -1
         if self.negative_pool == -1:
-            if self.pairs_per_batch < 4096 and not self.use_pallas:
+            if self.cbow and self.duplicate_scaling:
+                # mean semantics exist only on the per-example scatter path
+                self.negative_pool = 0
+            elif (self.pairs_per_batch < 4096 and not self.use_pallas
+                    and self.cbow_update != "banded"):
                 # Small batches take the per-pair exact path (the reference's G3
                 # semantics): the shared pool's matmul amortization buys nothing at
                 # this scale, and shared negatives measurably cost quality on small
@@ -352,9 +433,15 @@ class Word2VecConfig:
 
     def replace(self, **kwargs) -> "Word2VecConfig":
         if (getattr(self, "_auto_pool", False) and "negative_pool" not in kwargs
-                and ("pairs_per_batch" in kwargs or "negatives" in kwargs)):
-            # the pool was auto-derived from the OLD batch geometry — re-derive it
-            # for the new one instead of freezing a now-undersized pool
+                and any(k in kwargs for k in (
+                    "pairs_per_batch", "negatives",
+                    # these change which pool the AUTO rule resolves (banded
+                    # forces one at any batch size, cbow+duplicate_scaling
+                    # forces 0) — a frozen resolved value would trip the
+                    # selection-matrix refusals the user never opted into
+                    "cbow", "cbow_update", "duplicate_scaling", "use_pallas"))):
+            # the pool was auto-derived under the OLD geometry/path — re-derive
+            # it for the new one instead of freezing a now-wrong pool
             kwargs["negative_pool"] = -1
         if (getattr(self, "_auto_subsample", False)
                 and "subsample_ratio" not in kwargs):
@@ -381,4 +468,12 @@ class Word2VecConfig:
         clean = {k: v for k, v in d.items() if k in fields}
         if "mesh_shape" in clean and clean["mesh_shape"] is not None:
             clean["mesh_shape"] = tuple(clean["mesh_shape"])
+        if (clean.get("cbow") and clean.get("duplicate_scaling")
+                and clean.get("negative_pool", 0)
+                and clean.get("cbow_update", "scatter") == "scatter"):
+            # pre-selection-matrix checkpoints stored a resolved auto pool next
+            # to cbow+duplicate_scaling; the old trainer IGNORED that pool
+            # (warn-only, per-example negatives), so normalizing to 0 preserves
+            # the exact trained semantics — refusing would brick the checkpoint
+            clean["negative_pool"] = 0
         return cls(**clean)

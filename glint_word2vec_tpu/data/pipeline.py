@@ -482,6 +482,74 @@ def _block_cbow(
             np.flatnonzero(has_ctx) + 1, int(Nk))
 
 
+def pack_halo_token_blocks(
+    slabs: Iterable[Tuple[np.ndarray, np.ndarray]],
+    T: int,
+    halo: int,
+    tok_dtype=np.int32,
+) -> Iterator[Tuple[np.ndarray, np.ndarray, int, int, int]]:
+    """Sentence-contiguous [T]-slot token blocks with a ±``halo`` overlap — the
+    feed granule of the banded CBOW step (ops/cbow_banded.py).
+
+    ``slabs`` yields (kept_tokens, start_flags) chunks of the kept-token stream
+    (already subsampled; ``start_flags[i]`` True iff a sentence begins at that
+    token — the stream's first token must carry a flag). Blocks advance by the
+    CORE width ``Tc = T − 2·halo``: block k holds kept positions
+    ``[k·Tc − halo, k·Tc − halo + T)``, so every kept token is a **core** slot
+    (``[halo, T−halo)``) of exactly one block and a halo slot of its neighbors.
+    With ``halo ≥ window`` the overlap makes chunk-edge windows EXACT — both the
+    forward context mean and the backward context gradient of a center near a
+    cut see/reach their cross-cut neighbors (contrast the non-overlapping
+    skip-gram device feed, which loses ~0.02% of windows at the cuts).
+
+    Pre-stream slots of block 0 (positions < 0) are zero tokens with no start
+    bits; they are never centers (core slots begin at slot ``halo`` = stream
+    position 0) and never contexts (the stream-start start bit clamps every
+    real window at position 0), so they ride as inert padding inside the valid
+    prefix.
+
+    Yields ``(tokens[T], start_bits, n_valid, ordinal_base, n_core)`` per
+    block: ``n_valid`` counts the valid slot prefix, ``ordinal_base`` is the
+    kept-token ordinal of slot 0 (wrapped to uint64 — block 0's is −halo), and
+    ``n_core`` the NEW core tokens this block trains (the lr-clock increment;
+    overlap slots are not re-counted).
+    """
+    if halo <= 0:
+        raise ValueError(f"halo must be positive, got {halo}")
+    Tc = T - 2 * halo
+    if Tc <= 0:
+        raise ValueError(f"T={T} leaves no core slots at halo={halo}")
+    buf_tok = np.zeros(halo, tok_dtype)   # virtual pre-stream slots of block 0
+    buf_start = np.zeros(halo, bool)
+    bpos = -halo                          # stream position of buf[0]
+
+    def emit(n_core: int):
+        n = min(buf_tok.shape[0], T)
+        tokens = np.zeros(T, tok_dtype)
+        tokens[:n] = buf_tok[:n]
+        bits = np.packbits(np.pad(buf_start[:n], (0, T - n)),
+                           bitorder="little")
+        return (tokens, bits, n, bpos & 0xFFFFFFFFFFFFFFFF, n_core)
+
+    for ktoks, kstart in slabs:
+        if ktoks.shape[0] == 0:
+            continue
+        buf_tok = np.concatenate([buf_tok, ktoks.astype(tok_dtype)])
+        buf_start = np.concatenate([buf_start, kstart])
+        while buf_tok.shape[0] >= T:
+            yield emit(Tc)
+            buf_tok = buf_tok[Tc:]
+            buf_start = buf_start[Tc:].copy()
+            bpos += Tc
+    # flush: emit while un-centered core positions remain (len > halo ⟺ some
+    # stream token at position ≥ bpos + halo has not been a core slot yet)
+    while buf_tok.shape[0] > halo:
+        yield emit(min(buf_tok.shape[0] - halo, Tc))
+        buf_tok = buf_tok[Tc:]
+        buf_start = buf_start[Tc:].copy()
+        bpos += Tc
+
+
 @dataclass
 class CbowBatch:
     centers: np.ndarray    # int32 [B]

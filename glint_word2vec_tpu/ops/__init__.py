@@ -1,3 +1,4 @@
+from glint_word2vec_tpu.ops.cbow_banded import cbow_step_banded_core
 from glint_word2vec_tpu.ops.sampler import AliasTable, build_alias_table, sample_negatives
 from glint_word2vec_tpu.ops.sgns import (
     init_embeddings,
@@ -17,5 +18,6 @@ __all__ = [
     "sgns_step",
     "sgns_step_shared",
     "cbow_step",
+    "cbow_step_banded_core",
     "alpha_schedule",
 ]

@@ -1,0 +1,218 @@
+"""Banded CBOW step: O(B) context gather/scatter via sentence-ordered prefix sums.
+
+The scatter formulation (:func:`glint_word2vec_tpu.ops.sgns.cbow_step_shared_core`)
+treats each example's context window as an unordered [B, C] index set: it gathers
+``syn0[contexts]`` and scatters ``d_ctx`` as **B·C rows** (~655k at B=64k, C≈10).
+PERF.md §2 prices the scatter emitter at ~27–39 ns per update row, so those rows —
+not compute — are the measured 33.6 ms CBOW step (BENCH_r05).
+
+But CBOW batches are sliding windows over the *kept-token stream*: when batch
+position b holds kept token b (sentence-contiguous feed), both directions of the
+context traffic are **banded sums over batch positions**:
+
+- forward: ``hidden_b = (Σ_{j=b-l_b}^{b+r_b} e_j − e_b) / n_b`` — an interval sum,
+  i.e. one difference of an inclusive prefix sum ``S`` over the gathered rows:
+  ``S[b+r_b] − S[b−l_b−1] − e_b``;
+- backward: position j receives ``Σ_{b: j ∈ [b−l_b, b+r_b]} d_hidden_b / n_b`` —
+  the classic difference-array trick: add ``g_b = d_hidden_b/n_b`` at interval
+  start ``b−l_b``, subtract it at ``b+r_b+1``, prefix-sum, then remove the
+  self-term ``g_b`` at b.
+
+Cost: ONE [T]-row ``syn0`` gather + two [T, D] prefix sums (the two-level
+triangular-matmul form from ops/pairgen, ~0.5 ms each at 64k×384 on v5e) + the
+interval-endpoint accumulation + [T]-row scatters back into syn0/syn1 — ~3–4·B
+update rows total instead of ~11·B, which the §2 cost model prices at ≥2× CBOW
+examples/s (PERF.md §9 has the full accounting).
+
+Window intervals never cross sentence boundaries (``device_cbow_windows`` clamps
+them via the start bits), so prefix-sum *differences* are exact per sentence even
+though the prefix runs over the whole block; the same argument makes one flat
+prefix correct across the [Sd, T] → [Sd·T] segment concatenation the trainer
+feeds (intervals are in-block by construction, so cross-segment prefix mass
+cancels in every difference).
+
+Precision: prefix sums accumulate in ``promote_types(param_dtype, float32)`` —
+a bf16 prefix over 64k rows would lose the interval in the cancellation; float32
+keeps the relative error of an ~10-row interval at ~1e-5, far below SGD noise
+(the float64 CPU equivalence suite in tests/test_cbow_banded.py pins the math).
+
+``duplicate_scaling=True`` is NOT supported here — its mean-update bookkeeping
+is per-occurrence-count over the materialized context sets; config validation
+routes that combination to the scatter path (the selection matrix lives at
+trainer._build_step).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from glint_word2vec_tpu.ops.sgns import (
+    EmbeddingPair,
+    StepMetrics,
+    _log_sigmoid,
+    _sigmoid,
+)
+
+# above this window the unrolled shifted-add endpoint accumulation (2·window
+# fused [T, D] terms) loses to two plain scatter-adds of T rows each
+_SHIFT_UNROLL_MAX_WINDOW = 16
+
+
+def cumsum_rows(x: jax.Array) -> jax.Array:
+    """Inclusive prefix sum along axis 0 of a [T, D] array.
+
+    The float twin of ops/pairgen._cumsum_i32: XLA's 1-D cumulative ops cost
+    ~0.45 ms at 28k elements on TPU, so the within-chunk prefix runs as a
+    [128, 128] triangular matmul on the MXU and only the [T/128, D] chunk
+    totals take the (tiny) native cumsum. Unlike the int variant there is no
+    exactness window — callers pick an accumulation dtype wide enough for
+    their cancellation needs (the banded step uses ≥ float32).
+    """
+    T, D = x.shape
+    chunk = 128
+    rows = -(-T // chunk)
+    xp = jnp.pad(x, ((0, rows * chunk - T), (0, 0))).reshape(rows, chunk, D)
+    tri = jnp.tril(jnp.ones((chunk, chunk), x.dtype))  # [i, j] = 1 iff j <= i
+    within = jnp.einsum("ij,rjd->rid", tri, xp)        # inclusive within-chunk
+    totals = within[:, -1, :]                          # [rows, D]
+    offs = jnp.cumsum(totals, axis=0) - totals         # exclusive chunk offsets
+    return (within + offs[:, None, :]).reshape(rows * chunk, D)[:T]
+
+
+def _band_endpoint_delta(
+    g: jax.Array,      # [T, D] per-example spread gradient (masked rows are 0)
+    left: jax.Array,   # int32 [T]
+    right: jax.Array,  # int32 [T]
+    window: int,
+) -> jax.Array:
+    """The difference array of the banded backward accumulation: +g_b at each
+    interval start ``b−left_b``, −g_b at each one-past-end ``b+right_b+1``
+    (ends falling at T are dropped — their prefix mass is never read).
+
+    Since ``left ∈ [0, window)`` and ``right+1 ∈ [1, window]``, small windows
+    realize both endpoint adds as 2·window statically-unrolled shifted
+    masked adds (pure elementwise — XLA fuses them into one pass, no scatter
+    rows at all); large windows fall back to one 2T-row scatter-add, still
+    ~5× fewer scatter rows than the B·C formulation."""
+    T, D = g.shape
+    t = jnp.arange(T, dtype=jnp.int32)
+    if window > _SHIFT_UNROLL_MAX_WINDOW:
+        idx = jnp.concatenate([t - left, t + right + 1])
+        upd = jnp.concatenate([g, -g])
+        return jnp.zeros((T + 1, D), g.dtype).at[idx].add(
+            upd, mode="drop")[:T]
+    # start marks: g_b lands at j = b − left_b  ⇔  left[j+d] == d, d ∈ [0, W)
+    gs = jnp.pad(g, ((0, window), (0, 0)))
+    ls = jnp.pad(left, (0, window), constant_values=-1)
+    delta = jnp.zeros((T, D), g.dtype)
+    for d in range(window):
+        sel = (ls[d:d + T] == d).astype(g.dtype)[:, None]
+        delta = delta + gs[d:d + T] * sel
+    # end marks: g_b removed at j = b + right_b + 1  ⇔  right[j−d] == d−1,
+    # d ∈ [1, W] (legacy right ≤ W−2, symmetric ≤ W−1 — both covered)
+    ge = jnp.pad(g, ((window, 0), (0, 0)))
+    re = jnp.pad(right, (window, 0), constant_values=-2)
+    for d in range(1, window + 1):
+        sel = (re[window - d:window - d + T] == d - 1).astype(g.dtype)[:, None]
+        delta = delta - ge[window - d:window - d + T] * sel
+    return delta
+
+
+def cbow_step_banded_core(
+    params: EmbeddingPair,
+    tokens: jax.Array,       # int32 [T] — kept tokens, sentence-contiguous
+    left: jax.Array,         # int32 [T] — context extent left (in-sentence)
+    right: jax.Array,        # int32 [T] — context extent right
+    center_mask: jax.Array,  # float32 [T] — 1.0 for slots trained as centers
+    token_mask: jax.Array,   # float32 [T] — 1.0 for valid token slots
+    negatives: jax.Array,    # int32 [P] — pre-drawn shared pool
+    alpha: jax.Array,
+    num_negatives: int,
+    window: int,
+    sigmoid_mode: str = "exact",
+    compute_dtype: jnp.dtype = jnp.float32,
+    logits_dtype: jnp.dtype = jnp.float32,
+    with_metrics: bool = True,
+) -> Tuple[EmbeddingPair, StepMetrics]:
+    """Banded CBOW update — mathematically the shared-pool scatter step
+    (:func:`~glint_word2vec_tpu.ops.sgns.cbow_step_shared_core`) on the example
+    set {slot b : center_mask_b = 1, left_b + right_b > 0} with contexts
+    ``tokens[b−left_b : b+right_b+1] \\ {b}``, identical up to floating-point
+    summation order (asserted by tests/test_cbow_banded.py in float64).
+
+    (left, right) come from :func:`~glint_word2vec_tpu.ops.pairgen.device_cbow_windows`
+    and are guaranteed in-range (``b−left_b ≥ 0``, ``b+right_b < T``) and
+    in-sentence. Halo slots carry ``center_mask 0`` but ``token_mask 1``: they
+    train no example this block yet still receive their context gradient from
+    this block's core centers (their remaining gradient arrives in the block
+    where they are core — each (center, context) link is applied exactly once
+    across the overlapping feed).
+    """
+    syn0, syn1 = params
+    T = tokens.shape[0]
+    P = negatives.shape[0]
+    t = jnp.arange(T, dtype=jnp.int32)
+    pf = jnp.promote_types(syn0.dtype, jnp.float32)  # prefix accumulation dtype
+
+    ctx_n_i = left + right
+    has_ctx = (ctx_n_i > 0).astype(jnp.float32)
+    live = center_mask * has_ctx                                    # [T]
+
+    # -- forward: windowed context mean via one prefix-sum difference ---------
+    e = syn0[tokens]                                                # [T, D]
+    S = cumsum_rows(e.astype(pf))                                   # [T, D]
+    Spad = jnp.concatenate([jnp.zeros((1, S.shape[1]), pf), S])     # S[<i] sums
+    ctx_sum = Spad[t + right + 1] - Spad[t - left] - e.astype(pf)
+    ctx_n = jnp.maximum(ctx_n_i, 1).astype(pf)
+    hidden = (ctx_sum / ctx_n[:, None]).astype(compute_dtype)       # [T, D]
+
+    # -- shared-pool positive/negative chain, unchanged from the scatter step
+    tok_i = tokens.astype(jnp.int32)
+    e_out = syn1[tokens].astype(compute_dtype)                      # [T, D]
+    Z = syn1[negatives].astype(compute_dtype)                       # [P, D]
+    f_pos = jnp.sum(hidden * e_out, axis=-1).astype(jnp.float32)
+    f_neg = (hidden @ Z.T).astype(logits_dtype)                     # [T, P]
+    neg_valid = (negatives[None, :] != tok_i[:, None]).astype(logits_dtype) \
+        * center_mask[:, None].astype(logits_dtype)
+
+    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * live
+    g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode))
+             * jnp.asarray(alpha, logits_dtype) * neg_valid
+             * has_ctx[:, None].astype(logits_dtype)
+             * jnp.asarray(num_negatives / P, logits_dtype))
+
+    gp = g_pos[:, None].astype(compute_dtype)
+    gn = g_neg.astype(compute_dtype)
+    d_hidden = gp * e_out + gn @ Z                                  # [T, D]
+    d_out = gp * hidden
+    d_Z = gn.T @ hidden                                             # [P, D]
+
+    # -- backward: banded spread of d_hidden/n via difference array + prefix --
+    g_row = d_hidden.astype(pf) / ctx_n[:, None]                    # [T, D]
+    delta = _band_endpoint_delta(g_row, left, right, window)
+    d_ctx = (cumsum_rows(delta) - g_row) * token_mask[:, None].astype(pf)
+
+    dtype = syn0.dtype
+    new_syn0 = syn0.at[tokens].add(d_ctx.astype(dtype))
+    new_syn1 = syn1.at[tokens].add(d_out.astype(dtype))
+    new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
+
+    if with_metrics:
+        denom = jnp.maximum(live.sum(), 1.0)
+        loss = (-_log_sigmoid(f_pos) * live
+                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid
+                          * has_ctx[:, None].astype(logits_dtype), axis=-1,
+                          dtype=jnp.float32)
+                * (num_negatives / P)).sum() / denom
+        mean_f_pos = (f_pos * live).sum() / denom
+    else:
+        loss = mean_f_pos = jnp.float32(0.0)
+    metrics = StepMetrics(
+        loss=loss,
+        mean_f_pos=mean_f_pos,
+        pairs=live.sum(),
+    )
+    return EmbeddingPair(new_syn0, new_syn1), metrics

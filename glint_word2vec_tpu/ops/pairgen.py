@@ -218,6 +218,82 @@ def device_block_pairs(
         dropped_pairs=jnp.maximum(total_pairs - B, 0))
 
 
+class CbowBand(NamedTuple):
+    """Per-slot CBOW window geometry over a sentence-contiguous token block —
+    the device-side contract of the banded CBOW step (ops/cbow_banded.py)."""
+
+    left: jax.Array    # int32 [T] — context extent to the left of each slot
+    right: jax.Array   # int32 [T] — context extent to the right
+    center: jax.Array  # float32 [T] — 1.0 where the slot is a CORE center
+                       # (trains an example this block; halo slots are 0)
+    token: jax.Array   # float32 [T] — 1.0 for valid token slots (slots that may
+                       # receive context gradient; zero-padding is 0)
+
+
+def device_cbow_windows(
+    tokens: jax.Array,      # int32/uint16 [T] — KEPT (presubsampled) tokens,
+                            # sentence-contiguous, ±halo overlap at block edges
+    start_bits: jax.Array,  # uint8 [ceil(T/8)] — bit t set ⟺ sentence starts at t
+    n_valid: jax.Array,     # int32 [] — real token slots (prefix)
+    ord_lo: jax.Array,      # uint32 [] — kept-token ordinal of slot 0, low 32 bits
+    ord_hi: jax.Array,      # uint32 [] — high 32 bits
+    win_base: jax.Array,    # uint32 [] — hashrng stream base for STREAM_WINDOW
+    window: int,
+    halo: int,              # core slots are [halo, T - halo); needs halo >= window
+    legacy_asymmetric_window: bool = True,
+) -> CbowBand:
+    """Per-slot CBOW window extents from the hash lattice — the banded analog of
+    :func:`device_block_pairs` stages 3–4, skipping the ragged pair expansion:
+    the banded step (:func:`glint_word2vec_tpu.ops.cbow_banded.cbow_step_banded_core`)
+    consumes (left, right) intervals directly instead of materialized pairs.
+
+    The block is the kept-token stream cut with a ±``halo`` overlap
+    (:func:`glint_word2vec_tpu.data.pipeline.pack_halo_token_blocks`), so window
+    clamping is EXACT for every core slot with ``halo >= window``:
+
+    - left: ``l = min(b, pos)`` with pos measured from the last in-block sentence
+      start (slot 0 as implicit base). If the sentence started before the block,
+      ``pos >= t >= halo > b`` and the clamp never binds — identical to the true
+      stream. If it started in-block the start bit makes pos exact.
+    - right: ``r`` is clamped by the next in-block start bit or ``n_valid``. A
+      sentence end within reach of a core slot (r ≤ window-1 < halo) always has
+      its successor's start bit (or the stream end) inside the block, so the
+      clamp is exact too.
+
+    Window draws are keyed by the kept-token ordinal (``ord_base + t``), the same
+    key :func:`device_block_pairs` uses under ``presubsampled=True`` — a token
+    draws the same window in every block that holds it (halo or core).
+    """
+    T = tokens.shape[0]
+    t = jnp.arange(T, dtype=jnp.int32)
+    valid = t < n_valid
+
+    lo = ord_lo + t.astype(jnp.uint32)
+    hi = ord_hi + (lo < ord_lo).astype(jnp.uint32)
+
+    is_start = ((start_bits[t >> 3] >> (t & 7).astype(jnp.uint8)) & 1).astype(
+        jnp.bool_) & valid
+    seg_base = jax.lax.cummax(jnp.where(is_start, t, 0))
+    pos = t - seg_base
+    ns = jnp.where(is_start, t, T)
+    ns_next = jnp.concatenate([ns[1:], jnp.full(1, T, jnp.int32)])
+    seg_end = jnp.flip(jax.lax.cummin(jnp.flip(ns_next)))
+    seg_end = jnp.minimum(seg_end, n_valid)
+    right_avail = seg_end - 1 - t
+
+    b = hash_mod_at(win_base, lo, hi, window)
+    left = jnp.minimum(b, pos)
+    right_extent = b - 1 if legacy_asymmetric_window else b
+    right = jnp.clip(jnp.minimum(right_extent, right_avail), 0, None)
+    left = jnp.where(valid, left, 0)
+    right = jnp.where(valid, right, 0)
+    core = (t >= halo) & (t < T - halo) & valid
+    return CbowBand(
+        left=left, right=right,
+        center=core.astype(jnp.float32),
+        token=valid.astype(jnp.float32))
+
+
 def pack_start_bits(lengths: np.ndarray, T: int) -> np.ndarray:
     """Host-side: sentence lengths → the packed start-bit array a step ships.
 

@@ -64,6 +64,16 @@ def _pairs_per_kept_token(window: int) -> float:
     return max(float(b.mean() + np.clip(b - 1, 0, None).mean()), 1e-3)
 
 
+def _cbow_examples_per_kept_token(window: int) -> float:
+    """Analytic P[a kept token trains a CBOW example] under the legacy
+    asymmetric window: the b = nextInt(window) = 0 draw yields zero context
+    (and so no example), hence (window−1)/window. Sentence-boundary clipping
+    is ignored (slight overestimate — heartbeat display only; the banded feed
+    settles exact totals from the scanned metrics at end of run). Floored like
+    :func:`_pairs_per_kept_token`."""
+    return max((window - 1) / window, 1e-3)
+
+
 @dataclass
 class HeartbeatRecord:
     words: int
@@ -259,45 +269,16 @@ class Trainer:
                 raise ValueError("device_pairgen is skip-gram only (CBOW batches "
                                  "are grouped windows the device generator does "
                                  "not produce)")
-            if jax.process_count() > 1:
-                if not config.shard_input:
-                    raise ValueError(
-                        "device_pairgen with multiple processes requires "
-                        "shard_input=True (each process packs token blocks for "
-                        "its own data segments; a replicated token feed would "
-                        "have every process regenerate everything)")
-                if self.plan.num_data % jax.process_count():
-                    raise ValueError(
-                        f"device_pairgen across {jax.process_count()} processes "
-                        f"needs the mesh data degree ({self.plan.num_data}) "
-                        "divisible by the process count — each process produces "
-                        "num_data/process_count token segments")
             if config.use_pallas:
                 raise ValueError("device_pairgen is not supported with use_pallas")
-            S = self.plan.num_data
-            if config.pairs_per_batch % S:
-                raise ValueError(
-                    f"device_pairgen needs pairs_per_batch divisible by the data-"
-                    f"parallel degree ({config.pairs_per_batch} % {S} != 0)")
             if config.window == 1:
                 raise ValueError(
                     "device_pairgen with window=1 emits no pairs at all under the "
                     "reference's legacy asymmetric window (b = nextInt(1) = 0 "
                     "always, and the right bound is exclusive) — use window >= 2")
-            # resolve the duplicate-overload channel BEFORE deriving keep
-            # probabilities (an AUTO subsample may be lowered here); runs after
-            # the config-shape validations above so specific errors fire first
-            self._resolve_duplicate_channel()
-            from glint_word2vec_tpu.data.pipeline import keep_probabilities
-            keep = keep_probabilities(
-                vocab.counts, vocab.train_words_count,
-                self.config.subsample_ratio).astype(np.float32)
-            self._keep_host = keep
-            kp = np.zeros(self.padded_vocab, np.float32)
-            kp[:vocab.size] = keep
-            self._keep_prob_dev = put_global(plan.replicated, {"k": kp})["k"]
-            self._tokens_per_step = (config.tokens_per_step
-                                     or self._auto_tokens_per_step())
+            self._init_token_block_feed(
+                "device_pairgen",
+                config.tokens_per_step or self._auto_tokens_per_step())
             # ops/pairgen._cumsum_i32 is exact only while prefix sums stay below
             # 2^24 (f32 mantissa); the largest sum is T * (2*window - 1) pair counts
             if self._tokens_per_step * (2 * config.window - 1) >= 1 << 24:
@@ -306,9 +287,22 @@ class Trainer:
                     f"{config.window} overflows the device generator's exact-f32 "
                     f"prefix-sum bound (T * (2*window - 1) must stay below 2^24); "
                     "lower tokens_per_step or split the batch")
-            self._chunk_shardings = {"tokens": plan.tokens_stacked,
-                                     "starts": plan.tokens_stacked,
-                                     "obase": plan.tokens_stacked}
+        # Banded CBOW (config.cbow_update="banded", ops/cbow_banded.py): rides
+        # the same token-block feed plumbing as device_pairgen — the host packs
+        # kept-token blocks, the jitted step derives window draws from the hash
+        # lattice — but with a ±window halo overlap at block cuts
+        # (pipeline.pack_halo_token_blocks) so chunk-edge windows are exact.
+        # The config-level selection matrix already refused unsupported
+        # combinations (duplicate_scaling/pool=0/pallas/window=1).
+        self._banded_cbow = bool(config.cbow and config.cbow_update == "banded")
+        self._block_halo = 0
+        if self._banded_cbow:
+            self._block_halo = config.window
+            # core slots per segment block = examples per segment per step
+            self._init_token_block_feed(
+                "cbow_update='banded'",
+                config.pairs_per_batch // self.plan.num_data
+                + 2 * self._block_halo)
         # bound the duplicate-overload divergence channel (EVAL.md measured
         # boundary): auto-lower an AUTO subsample_ratio or refuse an explicit
         # unstable one. Idempotent — the device-feed path already resolved it
@@ -340,6 +334,47 @@ class Trainer:
             else self._step_fn)
 
     # -- setup -------------------------------------------------------------------------
+
+    def _init_token_block_feed(self, feature: str, tokens_per_step: int) -> None:
+        """Shared feed setup of the two token-block feeds (device_pairgen and
+        banded CBOW): multi-process segment-ownership checks, duplicate-channel
+        resolution BEFORE keep-probability derivation (an AUTO subsample may be
+        lowered there; feature-specific shape errors fire before this runs),
+        the replicated keep table, T, and the chunk shardings. One owner so the
+        two feeds cannot drift on these invariants."""
+        config = self.config
+        plan = self.plan
+        if jax.process_count() > 1:
+            if not config.shard_input:
+                raise ValueError(
+                    f"{feature} with multiple processes requires "
+                    "shard_input=True (each process packs token blocks for "
+                    "its own data segments; a replicated token feed would "
+                    "have every process regenerate everything)")
+            if plan.num_data % jax.process_count():
+                raise ValueError(
+                    f"{feature} across {jax.process_count()} processes "
+                    f"needs the mesh data degree ({plan.num_data}) "
+                    "divisible by the process count — each process produces "
+                    "num_data/process_count token segments")
+        Sd = plan.num_data
+        if config.pairs_per_batch % Sd:
+            raise ValueError(
+                f"{feature} needs pairs_per_batch divisible by the data-"
+                f"parallel degree ({config.pairs_per_batch} % {Sd} != 0)")
+        self._resolve_duplicate_channel()
+        from glint_word2vec_tpu.data.pipeline import keep_probabilities
+        keep = keep_probabilities(
+            self.vocab.counts, self.vocab.train_words_count,
+            self.config.subsample_ratio).astype(np.float32)
+        self._keep_host = keep
+        kp = np.zeros(self.padded_vocab, np.float32)
+        kp[:self.vocab.size] = keep
+        self._keep_prob_dev = put_global(plan.replicated, {"k": kp})["k"]
+        self._tokens_per_step = tokens_per_step
+        self._chunk_shardings = {"tokens": plan.tokens_stacked,
+                                 "starts": plan.tokens_stacked,
+                                 "obase": plan.tokens_stacked}
 
     def _auto_tokens_per_step(self) -> int:
         """Token slots per step for the device pair generator: targets ~93% pair-slot
@@ -542,6 +577,29 @@ class Trainer:
         def shared_pool_shape(K, B):  # negatives per chunk on the shared-pool paths
             return (K, cfg.negative_pool)
 
+        # CBOW update-path selection matrix (config.__post_init__ holds the
+        # validation-side twin — every unsupported combination is refused at
+        # construction, never silently downgraded):
+        #
+        #   cbow_update  duplicate_scaling  pool   → step
+        #   ------------ -----------------  -----  ---------------------------
+        #   "banded"     False              > 0    cbow_step_banded_core
+        #                                          (token-block feed + halo)
+        #   "banded"     True               any    REFUSED (config)
+        #   "banded"     False              = 0    REFUSED (config; banded is
+        #                                          built on the shared pool)
+        #   "scatter"    False              > 0    cbow_step_shared_core
+        #   "scatter"    True               = 0    cbow_step_core (per-example
+        #                                          negatives; explicit pool>0
+        #                                          REFUSED, auto resolves to 0)
+        #   "scatter"    False              = 0    cbow_step_core
+        #   any          any + use_pallas   any    REFUSED (SGNS-only kernel)
+        if self._banded_cbow:
+            if not quiet:
+                self._stability_warnings()
+            return self._build_banded_cbow_chunk(
+                with_metrics, compute_dtype, logits_dtype, seed)
+
         if cfg.use_pallas:
             from glint_word2vec_tpu.ops.pallas import sgns_kernel  # deferred import
             if cfg.duplicate_scaling:
@@ -592,11 +650,8 @@ class Trainer:
 
             neg_shape = shared_pool_shape
         elif cfg.cbow:
-            if cfg.negative_pool > 0 and not getattr(cfg, "_auto_pool", False):
-                logger.warning(
-                    "negative_pool is ignored for CBOW with duplicate_scaling=True "
-                    "(mean semantics are only implemented per-example); using "
-                    "per-example negative sampling")
+            # per-example CBOW (pool resolved to 0: small batches, or
+            # duplicate_scaling — config refuses an explicit pool beside it)
             self._stability_warnings(check_pool=False)
 
             def inner(params, batch, negatives, alpha):
@@ -717,6 +772,65 @@ class Trainer:
 
         return jax.jit(chunk, donate_argnums=(0,))
 
+    def _build_banded_cbow_chunk(
+        self,
+        with_metrics: bool,
+        compute_dtype: jnp.dtype,
+        logits_dtype: jnp.dtype,
+        seed: np.uint32,
+    ) -> Callable:
+        """Jitted chunk for cbow_update='banded': same feed/chunk signature as
+        the device_pairgen chunk (token blocks + hash-lattice draws on device;
+        keep_prob/sub_bases ride along unused — the packer presubsampled), but
+        each scan step derives per-slot CBOW window intervals
+        (ops/pairgen.device_cbow_windows) and applies the banded update
+        (ops/cbow_banded.cbow_step_banded_core). Segments are flattened
+        [Sd, T] → [Sd·T] for ONE prefix-sum pass: window intervals are
+        in-block by construction, so prefix differences never leak across
+        segments. The second return slot keeps the device-feed (metrics,
+        dropped) shape; banded blocks have fixed example slots, so dropped
+        is identically 0."""
+        cfg = self.config
+        from glint_word2vec_tpu.ops.cbow_banded import cbow_step_banded_core
+        from glint_word2vec_tpu.ops.pairgen import device_cbow_windows
+        W = cfg.window
+        H = self._block_halo
+        Sd = self.plan.num_data
+        emb_sharding = self._emb_sharding
+
+        win = jax.vmap(
+            lambda tk, st, nv, lo, hi, wb: device_cbow_windows(
+                tk, st, nv, lo, hi, wb, window=W, halo=H),
+            in_axes=(0, 0, 0, 0, 0, 0))
+
+        def banded_chunk(params, arrays, meta, base_step, prob, alias,
+                         keep_prob, sub_bases, win_bases):
+            del keep_prob, sub_bases  # host packer already subsampled
+            alphas, nvalid = meta[0], meta[1:].T          # [K], [K, Sd]
+            K = alphas.shape[0]
+            negatives = sample_negatives_hash(
+                prob, alias, seed, base_step, (K, cfg.negative_pool))
+
+            def body(p, inp):
+                xs, alpha, nv, negs = inp
+                ob = jax.lax.bitcast_convert_type(xs["obase"], jnp.uint32)
+                tok = xs["tokens"].astype(jnp.int32)
+                band = win(tok, xs["starts"], nv.astype(jnp.int32),
+                           ob[:, 0], ob[:, 1], win_bases)
+                new_p, metrics = cbow_step_banded_core(
+                    p, tok.reshape(-1),
+                    band.left.reshape(-1), band.right.reshape(-1),
+                    band.center.reshape(-1), band.token.reshape(-1),
+                    negs, alpha, cfg.negatives, W, cfg.sigmoid_mode,
+                    compute_dtype, logits_dtype, with_metrics)
+                new_p = jax.lax.with_sharding_constraint(
+                    new_p, EmbeddingPair(emb_sharding, emb_sharding))
+                return new_p, (metrics, jnp.int32(0))
+
+            return jax.lax.scan(body, params, (arrays, alphas, nvalid, negatives))
+
+        return jax.jit(banded_chunk, donate_argnums=(0,))
+
     def _dispatch_step_fn(self, max_steps: int) -> Callable:
         """The step function for the NEXT dispatch: the fast (metrics-elided)
         twin unless a heartbeat may sample this chunk's metrics. ``max_steps``
@@ -749,7 +863,10 @@ class Trainer:
             self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
         total_words = float(cfg.num_iterations * train_words + 1)
         K = max(1, cfg.steps_per_dispatch)
-        if self._feed_segments > 1 and cfg.device_pairgen:
+        # banded CBOW rides the token-block feed paths (same chunk plumbing as
+        # device_pairgen; its blocks overlap by ±window — see __init__)
+        token_feed = cfg.device_pairgen or self._banded_cbow
+        if self._feed_segments > 1 and token_feed:
             return self._fit_device_feed_sharded(
                 sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
                 total_words, float(train_words), K)
@@ -757,7 +874,7 @@ class Trainer:
             return self._fit_sharded(
                 sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
                 total_words, K)
-        if cfg.device_pairgen:
+        if token_feed:
             return self._fit_device_feed(
                 sentences, checkpoint_path, checkpoint_every_steps, on_heartbeat,
                 total_words, float(train_words), K)
@@ -766,9 +883,10 @@ class Trainer:
             # replicated pair feed — resuming here would silently mis-position
             if self.state.shard_feed == "tokens":
                 raise ValueError(
-                    "checkpoint was written by a device-feed run (its "
+                    "checkpoint was written by a token-block-feed run (its "
                     "positions index per-segment token streams); resume it "
-                    "with device_pairgen=True")
+                    "with the same feed — device_pairgen=True, or "
+                    "cbow_update='banded' if it was a banded-CBOW run")
             raise ValueError(
                 "checkpoint was written by a sharded-input multi-process run "
                 f"({len(self.state.shard_progress)} shards); resume it with the "
@@ -923,11 +1041,17 @@ class Trainer:
 
         Deterministic per (seed, k, s) and independent of which process runs it —
         the property the sharded multi-process feed relies on (a 2-process run's
-        segment s is bit-identical to a single-process run's)."""
+        segment s is bit-identical to a single-process run's).
+
+        Banded-CBOW mode (self._block_halo > 0): the same kept stream is cut
+        with a ±halo OVERLAP instead (pipeline.pack_halo_token_blocks) — blocks
+        advance by T − 2·halo core slots, so chunk-edge windows are exact (no
+        cross-cut context loss at all) and the 5th tuple element counts only
+        the NEW core tokens (the lr clock must not double-count overlap)."""
         from glint_word2vec_tpu.data.hashrng import (
             STREAM_SUBSAMPLE, hash_u01_at, stream_base)
         from glint_word2vec_tpu.data.pipeline import (
-            iter_sentence_slabs, stream_rng)
+            iter_sentence_slabs, pack_halo_token_blocks, stream_rng)
         cfg = self.config
         Sd = self.plan.num_data
         T = self._tokens_per_step
@@ -938,7 +1062,37 @@ class Trainer:
         if cfg.shuffle:
             rng.shuffle(order)
         sub_base = stream_base(cfg.seed, STREAM_SUBSAMPLE, k, s)
-        base, raw_ord = 0, 0
+
+        def kept_slabs():
+            """(kept_tokens, sentence_start_flags) per ~1M-raw-token slab."""
+            raw_ord = 0
+            for slab in iter_sentence_slabs(sentences, order):
+                tokens = np.concatenate(slab) if len(slab) > 1 else slab[0]
+                lens = np.fromiter(
+                    (x.shape[0] for x in slab), np.int64, len(slab))
+                n = tokens.shape[0]
+                sids = np.repeat(np.arange(len(slab)), lens)
+                if cfg.subsample_ratio > 0:
+                    u = hash_u01_at(sub_base, np.arange(
+                        raw_ord, raw_ord + n, dtype=np.uint64))
+                    m = u <= keep[tokens]
+                    ktoks, ksids = tokens[m], sids[m]
+                else:
+                    ktoks, ksids = tokens, sids
+                raw_ord += n
+                if ktoks.shape[0] == 0:
+                    continue
+                kstart = np.empty(ktoks.shape[0], bool)
+                kstart[0] = True
+                kstart[1:] = ksids[1:] != ksids[:-1]
+                yield ktoks.astype(tok_dt), kstart
+
+        if self._block_halo:
+            yield from pack_halo_token_blocks(
+                kept_slabs(), T, self._block_halo, tok_dt)
+            return
+
+        base = 0
         rest_tok = np.empty(0, tok_dt)
         rest_start = np.empty(0, bool)
 
@@ -949,25 +1103,8 @@ class Trainer:
             bits = np.packbits(np.pad(starts, (0, T - n)), bitorder="little")
             return (buf, bits, n, base, float(n))
 
-        for slab in iter_sentence_slabs(sentences, order):
-            tokens = np.concatenate(slab) if len(slab) > 1 else slab[0]
-            lens = np.fromiter((x.shape[0] for x in slab), np.int64, len(slab))
-            n = tokens.shape[0]
-            sids = np.repeat(np.arange(len(slab)), lens)
-            if cfg.subsample_ratio > 0:
-                u = hash_u01_at(sub_base, np.arange(
-                    raw_ord, raw_ord + n, dtype=np.uint64))
-                m = u <= keep[tokens]
-                ktoks, ksids = tokens[m], sids[m]
-            else:
-                ktoks, ksids = tokens, sids
-            raw_ord += n
-            if ktoks.shape[0] == 0:
-                continue
-            kstart = np.empty(ktoks.shape[0], bool)
-            kstart[0] = True
-            kstart[1:] = ksids[1:] != ksids[:-1]
-            rest_tok = np.concatenate([rest_tok, ktoks.astype(tok_dt)])
+        for ktoks, kstart in kept_slabs():
+            rest_tok = np.concatenate([rest_tok, ktoks])
             rest_start = np.concatenate([rest_start, kstart])
             while rest_tok.shape[0] >= T:
                 yield emit(rest_tok[:T], rest_start[:T])
@@ -1055,7 +1192,10 @@ class Trainer:
         train_words: float,
         K: int,
     ) -> EmbeddingPair:
-        """fit() for the on-device pair generator (config.device_pairgen).
+        """fit() for the token-block feeds: the on-device pair generator
+        (config.device_pairgen) and banded CBOW (config.cbow_update="banded",
+        whose blocks overlap by ±window and whose "pairs" are CBOW examples —
+        the chunk/step plumbing below is shared unchanged).
 
         The host packs whole sentences into fixed [T]-token blocks per (step,
         data-segment) and ships raw tokens + packed sentence-start bits + ordinal
@@ -1094,7 +1234,9 @@ class Trainer:
                       if not (self.state.finished or seg_state) else 0)
         # analytic pairs/step estimate — heartbeat display only; exact totals come
         # back from the device (see end of method)
-        rate_per_kept = _pairs_per_kept_token(cfg.window)
+        rate_per_kept = (_cbow_examples_per_kept_token(cfg.window)
+                         if self._banded_cbow
+                         else _pairs_per_kept_token(cfg.window))
 
         def chunk_stream():
             for k in range(start_iter, cfg.num_iterations + 1):
@@ -1395,7 +1537,9 @@ class Trainer:
         seg_state = self._device_seg_resume_state()[pid * spp:(pid + 1) * spp]
         start_iter = min(it for it, _ in seg_state)
 
-        rate_per_kept = _pairs_per_kept_token(cfg.window)
+        rate_per_kept = (_cbow_examples_per_kept_token(cfg.window)
+                         if self._banded_cbow
+                         else _pairs_per_kept_token(cfg.window))
 
         def local_stream():
             """This process's chunks: K step-rows of spp [T]-token segment blocks

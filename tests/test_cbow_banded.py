@@ -1,0 +1,420 @@
+"""Equivalence suite for the banded CBOW step (ops/cbow_banded.py).
+
+The banded path must produce the SAME update as the shipped scatter step
+``cbow_step_shared_core`` on the same example set — it is a perf restructuring,
+not a new estimator. The suite pins that across the cases the formulation could
+get wrong: dynamic per-position windows, sentence boundaries inside a block,
+subsampled (kept) streams, padded tails, and — the banded-only hazard — examples
+whose windows cross a chunk cut (the ±window halo must make them exact).
+
+Float64 runs (via jax.experimental.enable_x64) hold the two formulations to
+~1e-12: at that tolerance any dropped/double-counted context link or off-by-one
+interval endpoint is a hard failure, not noise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.data.hashrng import (
+    STREAM_SUBSAMPLE,
+    STREAM_WINDOW,
+    hash_u01_at,
+    stream_base,
+)
+from glint_word2vec_tpu.data.pipeline import (
+    _subsample_and_window,
+    pack_halo_token_blocks,
+)
+from glint_word2vec_tpu.ops.cbow_banded import cbow_step_banded_core, cumsum_rows
+from glint_word2vec_tpu.ops.pairgen import device_cbow_windows
+from glint_word2vec_tpu.ops.sgns import EmbeddingPair, cbow_step_shared_core
+
+SEED, IT, SHARD = 7, 1, 0
+
+
+# ---------------------------------------------------------------------------
+# corpus / reference helpers
+# ---------------------------------------------------------------------------
+
+
+def _kept_stream(rng, vocab, n_sentences, max_len, subsample=0.0):
+    """A random-sentence corpus reduced to its kept-token stream exactly like
+    the trainer's packer (_device_seg_blocks): raw-ordinal-keyed hash
+    subsample, sentence-start flags on the kept stream."""
+    lens = rng.integers(1, max_len, n_sentences)
+    toks = rng.integers(0, vocab, lens.sum()).astype(np.int32)
+    sids = np.repeat(np.arange(n_sentences), lens)
+    if subsample > 0:
+        sub_base = stream_base(SEED, STREAM_SUBSAMPLE, IT, SHARD)
+        # a crude keep curve is enough — the test only needs SOME tokens gone
+        keep = np.minimum(
+            0.2 + 0.8 * rng.random(vocab), 1.0).astype(np.float32)
+        u = hash_u01_at(sub_base, np.arange(toks.shape[0], dtype=np.uint64))
+        m = u <= keep[toks]
+        toks, sids = toks[m], sids[m]
+    if toks.shape[0] == 0:
+        return toks, np.zeros(0, bool)
+    starts = np.empty(toks.shape[0], bool)
+    starts[0] = True
+    starts[1:] = sids[1:] != sids[:-1]
+    return toks, starts
+
+
+def _host_windows(ktoks, starts, window):
+    """(left, right) per kept position — the host mirror the device derivation
+    must match: pipeline._subsample_and_window on the kept stream (keep ≡ 1,
+    ordinals = kept ordinals, the presubsampled-feed keying)."""
+    lens = np.diff(np.concatenate(
+        [np.flatnonzero(starts), [ktoks.shape[0]]])).astype(np.int64)
+    out = _subsample_and_window(
+        ktoks, lens, np.ones(int(ktoks.max()) + 1, np.float32), window,
+        SEED, IT, SHARD, 0, True)
+    toks2, left, total, nk = out
+    np.testing.assert_array_equal(toks2, ktoks)
+    return left.astype(np.int64), (total - left).astype(np.int64)
+
+
+def _scatter_reference(params, ktoks, left, right, sel, negatives, alpha,
+                       num_negatives, window, dtype):
+    """One cbow_step_shared_core step over the stream positions in ``sel``."""
+    C = 2 * window
+    nb = len(sel)
+    ctx = np.zeros((nb, C), np.int32)
+    ctxm = np.zeros((nb, C), np.float32)
+    for i, b in enumerate(sel):
+        idx = (list(range(b - left[b], b))
+               + list(range(b + 1, b + right[b] + 1)))
+        ctx[i, :len(idx)] = ktoks[idx]
+        ctxm[i, :len(idx)] = 1.0
+    return cbow_step_shared_core(
+        params, jnp.asarray(ktoks[sel].astype(np.int32)), jnp.asarray(ctx),
+        jnp.asarray(ctxm), jnp.ones(nb, jnp.float32), negatives, alpha,
+        num_negatives, "exact", dtype)
+
+
+def _banded_blocks(ktoks, starts, T, window):
+    """Halo blocks + device window derivation for each, as the trainer feeds
+    them (win_base keyed like the presubsampled device feed)."""
+    win_base = stream_base(SEED, STREAM_WINDOW, IT, SHARD)
+    out = []
+    for tb, bits, nv, ob, nc in pack_halo_token_blocks(
+            [(ktoks, starts)], T, window, np.int32):
+        band = device_cbow_windows(
+            jnp.asarray(tb), jnp.asarray(bits), jnp.int32(nv),
+            jnp.uint32(ob & 0xFFFFFFFF), jnp.uint32(ob >> 32),
+            jnp.uint32(win_base), window=window, halo=window)
+        out.append((tb, band, nc))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def test_cumsum_rows_matches_numpy():
+    rng = np.random.default_rng(0)
+    for T, D in ((1, 3), (127, 8), (128, 8), (300, 7), (1000, 5)):
+        x = rng.normal(size=(T, D)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(cumsum_rows(jnp.asarray(x))), np.cumsum(x, axis=0),
+            rtol=1e-5, atol=1e-5)
+
+
+def test_halo_blocks_cover_every_token_once():
+    rng = np.random.default_rng(1)
+    ktoks, starts = _kept_stream(rng, 50, 30, 12)
+    L = ktoks.shape[0]
+    H, T = 4, 20
+    Tc = T - 2 * H
+    blocks = list(pack_halo_token_blocks([(ktoks, starts)], T, H, np.int32))
+    assert sum(b[4] for b in blocks) == L          # every token a core once
+    covered = 0
+    for tb, bits, nv, ob, nc in blocks:
+        # the core slots hold exactly the next nc stream tokens
+        np.testing.assert_array_equal(
+            tb[H:H + nc], ktoks[covered:covered + nc])
+        assert nc <= Tc
+        assert nv <= T
+        # ordinal base points H before the first core slot's stream position
+        assert (ob - ((covered - H) & 0xFFFFFFFFFFFFFFFF)
+                ) % (1 << 64) == 0
+        covered += nc
+    # streams shorter than one block still emit their cores
+    short = list(pack_halo_token_blocks(
+        [(ktoks[:3], starts[:3])], T, H, np.int32))
+    assert sum(b[4] for b in short) == 3
+    # empty stream emits nothing
+    assert list(pack_halo_token_blocks([], T, H, np.int32)) == []
+
+
+def test_device_windows_match_host_across_blocks():
+    """The chunk-edge case: device-derived (left, right) of every CORE slot —
+    including slots whose window crosses a block cut and lives in the halo —
+    must equal the host pipeline's sentence-clamped extents."""
+    rng = np.random.default_rng(2)
+    W = 4
+    ktoks, starts = _kept_stream(rng, 60, 40, 14)
+    left_h, right_h = _host_windows(ktoks, starts, W)
+    covered = 0
+    for tb, band, nc in _banded_blocks(ktoks, starts, 3 * W + 9, W):
+        lb, rb = np.asarray(band.left), np.asarray(band.right)
+        cm = np.asarray(band.center)
+        core = slice(W, W + nc)
+        np.testing.assert_array_equal(
+            lb[core], left_h[covered:covered + nc])
+        np.testing.assert_array_equal(
+            rb[core], right_h[covered:covered + nc])
+        assert cm[core].all()
+        assert cm[:W].sum() == 0 and cm[W + nc:].sum() == 0
+        covered += nc
+    assert covered == ktoks.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# step equivalence vs the scatter oracle
+# ---------------------------------------------------------------------------
+
+
+def _equivalence_case(dtype, rtol, atol, subsample):
+    rng = np.random.default_rng(3)
+    V, D, P, W, NEG = 120, 16, 32, 3, 4
+    ktoks, starts = _kept_stream(rng, 40, 15, V, subsample=subsample)
+    left_h, right_h = _host_windows(ktoks, starts, W)
+    live = np.flatnonzero(left_h + right_h > 0)
+    assert live.size > 20   # the case actually exercises dynamic windows
+
+    params0 = EmbeddingPair(
+        jnp.asarray(rng.normal(0, 0.1, (V, D)), dtype),
+        jnp.asarray(rng.normal(0, 0.05, (V, D)), dtype))
+    negs = jnp.asarray(rng.integers(0, V, P), jnp.int32)
+    alpha = jnp.asarray(0.05, dtype)
+
+    # --- single block: everything (sentences, padding tail) in one step -----
+    T = ktoks.shape[0] + 2 * W + 5
+    ((tb, band, nc),) = _banded_blocks(ktoks, starts, T, W)
+    p_band, m_band = cbow_step_banded_core(
+        params0, jnp.asarray(tb), band.left, band.right, band.center,
+        band.token, negs, alpha, NEG, W, "exact", dtype)
+    p_ref, m_ref = _scatter_reference(
+        params0, ktoks, left_h, right_h, live, negs, alpha, NEG, W, dtype)
+    np.testing.assert_allclose(
+        np.asarray(p_band.syn0), np.asarray(p_ref.syn0), rtol=rtol, atol=atol)
+    np.testing.assert_allclose(
+        np.asarray(p_band.syn1), np.asarray(p_ref.syn1), rtol=rtol, atol=atol)
+    np.testing.assert_allclose(
+        float(m_band.loss), float(m_ref.loss), rtol=max(rtol, 1e-6))
+    assert float(m_band.pairs) == float(m_ref.pairs) == live.size
+
+    # --- multi block: sequential steps, windows crossing every cut ----------
+    p_cur = params0
+    p_refc = params0
+    covered = 0
+    for tb, band, nc in _banded_blocks(ktoks, starts, 4 * W + 6, W):
+        p_cur, _ = cbow_step_banded_core(
+            p_cur, jnp.asarray(tb), band.left, band.right, band.center,
+            band.token, negs, alpha, NEG, W, "exact", dtype)
+        sel = live[(live >= covered) & (live < covered + nc)]
+        covered += nc
+        if sel.size:
+            p_refc, _ = _scatter_reference(
+                p_refc, ktoks, left_h, right_h, sel, negs, alpha, NEG, W,
+                dtype)
+    np.testing.assert_allclose(
+        np.asarray(p_cur.syn0), np.asarray(p_refc.syn0),
+        rtol=rtol * 5, atol=atol * 5)
+    np.testing.assert_allclose(
+        np.asarray(p_cur.syn1), np.asarray(p_refc.syn1),
+        rtol=rtol * 5, atol=atol * 5)
+
+
+def test_banded_equals_scatter_float32():
+    _equivalence_case(jnp.float32, 2e-5, 2e-6, subsample=0.0)
+
+
+def test_banded_equals_scatter_float32_subsampled():
+    _equivalence_case(jnp.float32, 2e-5, 2e-6, subsample=1e-1)
+
+
+def test_banded_equals_scatter_float64_tight():
+    """float64 on CPU: any structural mismatch (lost/duplicated context link,
+    off-by-one interval) is far above 1e-12 — this is the exactness pin."""
+    from jax.experimental import enable_x64
+    with enable_x64():
+        _equivalence_case(jnp.float64, 1e-12, 1e-14, subsample=0.0)
+
+
+def test_banded_metrics_elided_twin_bit_identical():
+    """with_metrics=False must change ONLY the loss side-channel — trained
+    params bit-identical (the trainer's fast-twin contract)."""
+    rng = np.random.default_rng(5)
+    V, D, P, W, NEG = 80, 8, 16, 3, 3
+    ktoks, starts = _kept_stream(rng, 20, 12, V)
+    T = ktoks.shape[0] + 2 * W + 3
+    ((tb, band, nc),) = _banded_blocks(ktoks, starts, T, W)
+    params0 = EmbeddingPair(
+        jnp.asarray(rng.normal(0, 0.1, (V, D)), jnp.float32),
+        jnp.asarray(rng.normal(0, 0.05, (V, D)), jnp.float32))
+    negs = jnp.asarray(rng.integers(0, V, P), jnp.int32)
+    args = (params0, jnp.asarray(tb), band.left, band.right, band.center,
+            band.token, negs, jnp.float32(0.05), NEG, W, "exact", jnp.float32,
+            jnp.float32)
+    p_full, m_full = cbow_step_banded_core(*args, with_metrics=True)
+    p_fast, m_fast = cbow_step_banded_core(*args, with_metrics=False)
+    np.testing.assert_array_equal(np.asarray(p_full.syn0),
+                                  np.asarray(p_fast.syn0))
+    np.testing.assert_array_equal(np.asarray(p_full.syn1),
+                                  np.asarray(p_fast.syn1))
+    assert float(m_fast.loss) == 0.0
+    assert float(m_fast.pairs) == float(m_full.pairs)
+
+
+def test_banded_scatter_fallback_for_large_windows():
+    """windows past the shifted-add unroll bound take the 2T-row scatter form
+    of the endpoint accumulation — same update either way."""
+    from glint_word2vec_tpu.ops import cbow_banded
+
+    rng = np.random.default_rng(6)
+    V, D, P, W, NEG = 100, 8, 16, 4, 3
+    ktoks, starts = _kept_stream(rng, 20, 20, V)
+    T = ktoks.shape[0] + 2 * W + 1
+    ((tb, band, nc),) = _banded_blocks(ktoks, starts, T, W)
+    params0 = EmbeddingPair(
+        jnp.asarray(rng.normal(0, 0.1, (V, D)), jnp.float32),
+        jnp.asarray(rng.normal(0, 0.05, (V, D)), jnp.float32))
+    negs = jnp.asarray(rng.integers(0, V, P), jnp.int32)
+    args = (params0, jnp.asarray(tb), band.left, band.right, band.center,
+            band.token, negs, jnp.float32(0.05), NEG, W)
+    p_shift, _ = cbow_step_banded_core(*args)
+    orig = cbow_banded._SHIFT_UNROLL_MAX_WINDOW
+    try:
+        cbow_banded._SHIFT_UNROLL_MAX_WINDOW = 0  # force the scatter form
+        p_scat, _ = cbow_step_banded_core(*args)
+    finally:
+        cbow_banded._SHIFT_UNROLL_MAX_WINDOW = orig
+    np.testing.assert_allclose(np.asarray(p_shift.syn0),
+                               np.asarray(p_scat.syn0), rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(p_shift.syn1),
+                               np.asarray(p_scat.syn1), rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# trainer integration + config matrix
+# ---------------------------------------------------------------------------
+
+
+def _toy_fit(cbow_update):
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    rng = np.random.default_rng(3)
+    words = [f"w{i}" for i in range(60)]
+    sentences = [[words[j] for j in rng.integers(0, 60, 15)]
+                 for _ in range(150)]
+    vocab = build_vocab(sentences, min_count=1)
+    cfg = Word2VecConfig(
+        vector_size=16, min_count=1, pairs_per_batch=256, num_iterations=2,
+        window=3, negatives=3, cbow=True, cbow_update=cbow_update,
+        negative_pool=128, steps_per_dispatch=2, seed=2,
+        subsample_ratio=1e-2, heartbeat_every_steps=4)
+    t = Trainer(cfg, vocab)
+    before = np.asarray(t.params.syn0).copy()
+    t.fit(encode_sentences(sentences, vocab, 1000))
+    return t, before
+
+
+def test_trainer_fit_banded_smoke():
+    t, before = _toy_fit("banded")
+    after = np.asarray(t.params.syn0)
+    assert np.isfinite(after).all()
+    assert not np.allclose(before, after)
+    assert t.pairs_trained > 0
+    assert t.heartbeats and np.isfinite(t.heartbeats[-1].loss)
+    # the metrics-elided fast twin is actually wired for this path
+    assert t._step_fn_fast is not t._step_fn
+
+
+def test_trainer_fit_banded_deterministic():
+    t1, _ = _toy_fit("banded")
+    t2, _ = _toy_fit("banded")
+    np.testing.assert_array_equal(np.asarray(t1.params.syn0),
+                                  np.asarray(t2.params.syn0))
+
+
+def test_config_selection_matrix_errors():
+    from glint_word2vec_tpu.config import Word2VecConfig
+
+    for kw, msg in [
+        (dict(cbow_update="banded"), "requires cbow=True"),
+        (dict(cbow=True, cbow_update="banded", duplicate_scaling=True),
+         "duplicate_scaling"),
+        (dict(cbow=True, cbow_update="banded", negative_pool=0),
+         "shared-pool"),
+        (dict(cbow=True, cbow_update="banded", use_pallas=True), "pallas"),
+        (dict(cbow=True, cbow_update="banded", tokens_per_step=64),
+         "tokens_per_step"),
+        (dict(cbow=True, cbow_update="banded", window=1), "window"),
+        (dict(cbow=True, cbow_update="bogus"), "cbow_update"),
+        (dict(cbow=True, duplicate_scaling=True, negative_pool=256),
+         "per-example"),
+    ]:
+        with pytest.raises(ValueError, match=msg.replace("(", "\\(")):
+            Word2VecConfig(**kw)
+    # AUTO pool resolutions around the matrix
+    assert Word2VecConfig(cbow=True, duplicate_scaling=True).negative_pool == 0
+    assert Word2VecConfig(cbow=True, cbow_update="banded",
+                          pairs_per_batch=256).negative_pool > 0
+    # scatter stays the default
+    assert Word2VecConfig(cbow=True).cbow_update == "scatter"
+
+
+def test_trainer_banded_config_roundtrip():
+    """cbow_update survives to_dict/from_dict (checkpoint metadata)."""
+    from glint_word2vec_tpu.config import Word2VecConfig
+
+    cfg = Word2VecConfig(cbow=True, cbow_update="banded")
+    d = cfg.to_dict(auto_markers=False)
+    assert d["cbow_update"] == "banded"
+    assert Word2VecConfig.from_dict(d).cbow_update == "banded"
+
+
+def test_from_dict_normalizes_legacy_ignored_pool():
+    """Pre-selection-matrix checkpoints could store cbow + duplicate_scaling +
+    a RESOLVED auto pool (the old trainer warn-ignored it and sampled
+    per-example). from_dict must normalize that to pool=0 — the semantics the
+    model actually trained with — instead of refusing to load the checkpoint."""
+    from glint_word2vec_tpu.config import Word2VecConfig
+
+    legacy = Word2VecConfig(cbow=True, pairs_per_batch=65536).to_dict(
+        auto_markers=False)
+    assert legacy["negative_pool"] > 0          # the resolved auto pool
+    legacy["duplicate_scaling"] = True          # the pre-change combination
+    cfg = Word2VecConfig.from_dict(legacy)
+    assert cfg.negative_pool == 0
+    # but a banded checkpoint keeps its pool (banded never ignored it), and
+    # banded+duplicate_scaling still refuses (it never existed to preserve)
+    banded = Word2VecConfig(cbow=True, cbow_update="banded").to_dict(
+        auto_markers=False)
+    assert Word2VecConfig.from_dict(banded).negative_pool > 0
+
+
+def test_replace_rederives_auto_pool_across_path_switches():
+    """replace() must re-run the AUTO pool rule when the update path changes,
+    not freeze the previously resolved value into a refused combination."""
+    from glint_word2vec_tpu.config import Word2VecConfig
+
+    cfg = Word2VecConfig(cbow=True, pairs_per_batch=65536)
+    assert cfg.negative_pool > 0
+    assert cfg.replace(duplicate_scaling=True).negative_pool == 0
+    small = Word2VecConfig(cbow=True, pairs_per_batch=128)
+    assert small.negative_pool == 0
+    assert small.replace(cbow_update="banded").negative_pool > 0
+    # an EXPLICIT pool is never silently rewritten — the refusal stands
+    explicit = Word2VecConfig(cbow=True, negative_pool=256,
+                              pairs_per_batch=65536)
+    with pytest.raises(ValueError, match="per-example"):
+        explicit.replace(duplicate_scaling=True)

@@ -56,12 +56,13 @@ vocab = build_vocab(sentences, min_count=1)
 cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
                      num_iterations=2, window=3, negatives=3, negative_pool=16,
                      steps_per_dispatch=2, seed=7, subsample_ratio=0.0,
-                     cbow=(mode == "cbow"),
+                     cbow=(mode in ("cbow", "banded")),
+                     cbow_update=("banded" if mode == "banded" else "scatter"),
                      device_pairgen=(mode in ("device", "device42", "dresume",
                                               "eshrink", "egrow", "varlen")),
                      shard_input=(mode in ("sharded", "resume", "cbow", "device",
                                            "device42", "dresume", "eshrink",
-                                           "egrow", "varlen")),
+                                           "egrow", "varlen", "banded")),
                      # every 2-process test also exercises the SPMD divergence
                      # detector on its real feeds (must stay silent)
                      feed_consistency_check=True)
@@ -153,7 +154,8 @@ else:
     trainer = Trainer(cfg, vocab, plan=plan)
     assert trainer.params.syn0.sharding.is_equivalent_to(plan.embedding, 2)
     assert trainer._feed_segments == (
-        2 if mode in ("sharded", "cbow", "device", "device42", "varlen") else 1)
+        2 if mode in ("sharded", "cbow", "device", "device42", "varlen",
+                      "banded") else 1)
     trainer.fit(encoded)
     checksum = checksum_of(trainer)
     assert np.isfinite(checksum)
@@ -272,6 +274,32 @@ def test_two_process_cbow_sharded_feed(tmp_path):
     """CBOW on the sharded-input feed (round-4: the allgather protocol carries the
     grouped centers/contexts/count arrays, not just packed pairs)."""
     _run_two(tmp_path, "cbow")
+
+
+@pytest.mark.slow
+def test_two_process_banded_cbow_bit_identity(tmp_path):
+    """Banded CBOW (cbow_update='banded') on the sharded token-block feed: the
+    halo-overlapped segment streams are deterministic and process-independent
+    (pipeline.pack_halo_token_blocks over _device_seg_blocks), so the 2-process
+    run must train on the byte-identical feed of the single-process banded run
+    — asserted by matching its checksum and exact example count."""
+    line = _run_two(tmp_path, "banded")
+    got = float(line.split()[1])
+    got_pairs = float(line.split()[5])
+
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    vocab, encoded, cfg, _, checksum = _parent_device_setup()
+    cfg = Word2VecConfig.from_dict(dict(
+        cfg.to_dict(), cbow=True, cbow_update="banded",
+        device_pairgen=False))
+    trainer = Trainer(cfg, vocab, plan=make_mesh(2, 4))
+    trainer.fit(encoded)
+    want = checksum(trainer)
+    assert got_pairs == trainer.pairs_trained, (got_pairs, trainer.pairs_trained)
+    assert abs(got - want) < 1e-6 * max(1.0, abs(want)), (got, want)
 
 
 @pytest.mark.slow

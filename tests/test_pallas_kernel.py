@@ -27,6 +27,50 @@ from glint_word2vec_tpu.ops.sgns import (
 V, D, P, N = 512, 128, 64, 5
 
 
+# exception classes that mean "this jax/jaxlib/backend cannot build or lower
+# the kernel" — a moved API surface (pltpu.CompilerParams ↔ TPUCompilerParams),
+# an unimplemented lowering, or an XLA runtime refusal. Anything OUTSIDE this
+# set (TypeError from a wiring bug, ValueError from bad shapes, assertion
+# failures) propagates and turns the suite red — only true environment
+# failures may skip.
+_ENV_ERROR_TYPES = [AttributeError, ImportError, NotImplementedError]
+try:
+    from jaxlib.xla_extension import XlaRuntimeError
+    _ENV_ERROR_TYPES.append(XlaRuntimeError)
+except ImportError:
+    pass
+
+
+def _pallas_env_error():
+    """Probe whether this environment can build AND run the Pallas kernel at
+    all (known-good shapes, interpret mode). Pallas's TPU API surface moves
+    between jax releases and some backends cannot lower the kernel — those are
+    ENVIRONMENT failures, not regressions, so the equivalence tests skip with
+    the probe's reason instead of running tier-1 red. Numerical mismatches are
+    untouched: the probe never compares values, it only checks the kernel
+    executes; non-environment exception classes propagate (see
+    _ENV_ERROR_TYPES)."""
+    try:
+        inner = make_pallas_sgns_step(N, P, "exact", jnp.float32, tile=64,
+                                      interpret=True)
+        params = EmbeddingPair(jnp.zeros((V, D), jnp.float32),
+                               jnp.zeros((V, D), jnp.float32))
+        batch = {"centers": jnp.zeros(64, jnp.int32),
+                 "contexts": jnp.ones(64, jnp.int32),
+                 "mask": jnp.ones(64, jnp.float32)}
+        inner(params, batch, jnp.zeros(P, jnp.int32), jnp.float32(0.01))
+        return None
+    except tuple(_ENV_ERROR_TYPES) as e:
+        return f"{type(e).__name__}: {e}"
+
+
+_PALLAS_ENV_ERROR = _pallas_env_error()
+needs_pallas = pytest.mark.skipif(
+    _PALLAS_ENV_ERROR is not None,
+    reason=("backend cannot lower/run the Pallas SGNS kernel in this "
+            f"environment: {_PALLAS_ENV_ERROR}"))
+
+
 def _setup(seed=0):
     rng = np.random.default_rng(seed)
     counts = rng.integers(1, 50, V)
@@ -61,6 +105,7 @@ def _run_both(table, params, centers, contexts, mask, tile, alpha=0.025):
     return got_params, got_metrics, want_params, want_metrics
 
 
+@needs_pallas
 def test_single_tile_equivalence():
     table, params, rng = _setup()
     centers, contexts, mask = _distinct_batch(rng, 256)
@@ -73,6 +118,7 @@ def test_single_tile_equivalence():
     assert float(got_m.pairs) == float(want_m.pairs)
 
 
+@needs_pallas
 def test_multi_tile_equivalence():
     # rows globally distinct → the kernel's sequential-tile semantics coincide with
     # XLA's batch-start-value semantics even across tiles
@@ -84,6 +130,7 @@ def test_multi_tile_equivalence():
     np.testing.assert_allclose(float(got_m.loss), float(want_m.loss), rtol=1e-5)
 
 
+@needs_pallas
 def test_masked_rows_do_not_clobber_row0():
     """The ADVICE finding: flush-padded entries have centers/contexts == 0; their
     writeback must be skipped or a stale row-0 value can overwrite a real row-0
@@ -106,6 +153,7 @@ def test_masked_rows_do_not_clobber_row0():
     np.testing.assert_allclose(float(got_m.pairs), float(want_m.pairs))
 
 
+@needs_pallas
 def test_trainer_smoke_use_pallas():
     """use_pallas=True constructs and trains end-to-end (the round-1 wiring bug made
     this raise TypeError before the first step)."""

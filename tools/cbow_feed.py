@@ -1,0 +1,79 @@
+"""Shared banded-CBOW measurement harness: feed packing + chunk builder.
+
+One owner for the wiring that `bench.py` (bench_cbow_banded_step) and
+`tools/step_ab.py` (run_cbow_ab) both need — the halo-block → device-feed
+packing (incl. the uint64 → 2×int32 ordinal-base split the trainer feed uses)
+and the trainer-shaped jitted chunk (scan + hash-PRNG negatives +
+device-derived windows + metrics-elided banded step). The two tools are the
+PERF.md §9 evidence chain for the same number; sharing the wiring means a
+future feed-contract change (obase packing, CbowBand fields) cannot make them
+disagree for harness reasons.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pack_banded_feeds(toks: np.ndarray, starts: np.ndarray, T: int, halo: int,
+                      n_sets: int, K: int) -> list:
+    """Cut a kept-token stream into ``n_sets`` device-feed dicts of K halo
+    blocks each ({tokens [K,T], starts, nvalid [K], obase [K,2] int32}) — the
+    single-segment shape of the trainer's banded chunk feed. The stream must
+    supply at least n_sets·K blocks (callers size it; StopIteration otherwise
+    is a sizing bug, not a harness feature)."""
+    import jax.numpy as jnp
+
+    from glint_word2vec_tpu.data.pipeline import pack_halo_token_blocks
+
+    blocks = pack_halo_token_blocks([(toks, starts)], T, halo, np.int32)
+    feeds = []
+    for _ in range(n_sets):
+        rows = [next(blocks) for _ in range(K)]
+        feeds.append({
+            "tokens": jnp.asarray(np.stack([r[0] for r in rows]), jnp.int32),
+            "starts": jnp.asarray(np.stack([r[1] for r in rows])),
+            "nvalid": jnp.asarray([r[2] for r in rows], jnp.int32),
+            "obase": jnp.asarray(np.asarray(
+                [[r[3] & 0xFFFFFFFF, r[3] >> 32] for r in rows],
+                np.uint32).view(np.int32)),
+        })
+    return feeds
+
+
+def make_banded_chunk(window: int, pool: int, num_negatives: int,
+                      compute_dtype, logits_dtype, win_base: int, K: int,
+                      seed: int = 1234):
+    """The trainer-shaped banded chunk: scan K steps, negatives from the hash
+    PRNG, per-slot windows derived on device, metrics elided (the production
+    steady state both measurement tools time). Returns a plain function for
+    the caller to jit (donate_argnums=(0,)): f(params, feed, base_step, prob,
+    alias) -> (params, losses)."""
+    import jax
+    import jax.numpy as jnp
+
+    from glint_word2vec_tpu.ops.cbow_banded import cbow_step_banded_core
+    from glint_word2vec_tpu.ops.pairgen import device_cbow_windows
+    from glint_word2vec_tpu.ops.sampler import sample_negatives_hash
+
+    wb = jnp.uint32(win_base)
+
+    def chunk(params, feed, base_step, prob, alias):
+        negs = sample_negatives_hash(prob, alias, seed, base_step, (K, pool))
+
+        def body(p, inp):
+            tb, bits, nv, ob, ng = inp
+            obu = jax.lax.bitcast_convert_type(ob, jnp.uint32)
+            band = device_cbow_windows(tb, bits, nv, obu[0], obu[1], wb,
+                                       window=window, halo=window)
+            new_p, m = cbow_step_banded_core(
+                p, tb, band.left, band.right, band.center, band.token,
+                ng, jnp.float32(0.025), num_negatives, window, "exact",
+                compute_dtype, logits_dtype, with_metrics=False)
+            return new_p, m.loss
+
+        return jax.lax.scan(body, params, (
+            feed["tokens"], feed["starts"], feed["nvalid"], feed["obase"],
+            negs))
+
+    return chunk

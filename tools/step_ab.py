@@ -17,6 +17,22 @@ sgns_step_shared_core (scatter-add is order-independent up to FP associativity):
                   savings potential with compaction.
 
 Run: python tools/step_ab.py [--dtype f32|bf16] [--b 65536] [--pool 256]
+
+--cbow mode: interleaved A/B of the two CBOW step formulations on the SAME
+synthetic Zipf sentence stream (PERF.md §9's measurement harness):
+
+    scatter — cbow_step_shared_core as shipped: grouped [B, 2w] context
+              batches, B·C-row syn0 gather+scatter (the BENCH cbow row)
+    banded  — cbow_step_banded_core: sentence-contiguous halo token blocks,
+              windows derived on device from the same hash lattice, context
+              traffic via prefix sums (ops/cbow_banded.py)
+
+Both run metrics-elided with a params-carry fetch (the production regime) and
+report examples/s over the REAL examples each step trains (the scatter batch
+packs B live examples; a banded block trains its ~(w−1)/w·B live core slots).
+
+Run: python tools/step_ab.py --cbow [--dtype bf16] [--b 65536] [--pool 512]
+     [--window 5] [--v 200000] [--d 384]
 """
 
 from __future__ import annotations
@@ -33,13 +49,166 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 V, D, NEG, K = 200_000, 384, 5, 16
 
 
+def run_cbow_ab(args) -> None:
+    """Interleaved banded-vs-scatter CBOW A/B on one shared sentence stream."""
+    import jax
+    import jax.numpy as jnp
+    from cbow_feed import make_banded_chunk, pack_banded_feeds
+    from microbench import time_chunked
+
+    from glint_word2vec_tpu.data.hashrng import (
+        STREAM_WINDOW, hash_mod_at, stream_base)
+    from glint_word2vec_tpu.ops.sampler import (
+        build_alias_table, sample_negatives_hash)
+    from glint_word2vec_tpu.ops.sgns import (
+        EmbeddingPair, cbow_step_shared_core, init_embeddings)
+
+    Vv, Dd = args.v, args.d
+    B, P, W = args.b, args.pool, args.window
+    C = 2 * W
+    H = W
+    T = B + 2 * H                      # banded: B core slots per step
+    n_sets = 4                         # rotating chunk sets (cache variety)
+    seed = 1234
+    dt = jnp.float32 if args.dtype == "f32" else jnp.bfloat16
+    print(f"device: {jax.devices()[0]}  CBOW A/B  dtype={args.dtype} "
+          f"B={B} pool={P} window={W} V={Vv} D={Dd}", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    counts = np.maximum(1e9 / (np.arange(Vv) + 10.0) ** 1.07, 5.0)
+    p = counts / counts.sum()
+    table = build_alias_table(counts)
+    prob, alias = table.prob, table.alias
+    syn0_0 = init_embeddings(Vv, Dd, jax.random.key(0)).syn0.astype(dt)
+    syn1_0 = jnp.asarray(rng.normal(0, 0.05, (Vv, Dd)), dt)
+
+    # ---- one shared kept-token stream: Zipf tokens, 40-token sentences ------
+    # sized so BOTH feeds draw fresh examples: banded consumes B stream tokens
+    # per step, scatter B LIVE examples (~(w-1)/w of tokens are live)
+    stream_len = int(n_sets * K * B * W / (W - 1) * 1.05) + 2 * H
+    toks = rng.choice(Vv, size=stream_len, p=p).astype(np.int32)
+    starts = np.zeros(stream_len, bool)
+    starts[::40] = True
+    win_base = stream_base(seed, STREAM_WINDOW, 1, 0)
+
+    # host mirror of the device window derivation (sentence-clamped extents),
+    # for the scatter batches and the real-example accounting
+    ordinals = np.arange(stream_len, dtype=np.uint64)
+    bdraw = hash_mod_at(win_base, ordinals, W).astype(np.int64)
+    sent_id = np.cumsum(starts) - 1
+    sstarts = np.flatnonzero(starts)                       # [n_sentences]
+    pos = np.arange(stream_len) - sstarts[sent_id]
+    nxt = np.concatenate([sstarts[1:], [stream_len]])
+    avail = nxt[sent_id] - 1 - np.arange(stream_len)
+    left = np.minimum(bdraw, pos)
+    right = np.clip(np.minimum(bdraw - 1, avail), 0, None)
+    total = left + right
+    live = np.flatnonzero(total > 0)
+
+    # ---- banded feed: K halo blocks per set (shared harness: cbow_feed.py) --
+    banded_sets = pack_banded_feeds(toks, starts, T, H, n_sets, K)
+    banded_live = float(len(live[live < n_sets * K * B])) / (n_sets * K)
+
+    # ---- scatter feed: K dense [B, C] grouped batches per set ---------------
+    scatter_sets = []
+    li = 0
+    for _ in range(n_sets):
+        cb, xb, nb = [], [], []
+        for _ in range(K):
+            sel = live[li:li + B]
+            li += B
+            lv, rv = left[sel], right[sel]
+            j = np.arange(C, dtype=np.int64)[None, :]
+            cpos = np.where(j < lv[:, None], sel[:, None] - lv[:, None] + j,
+                            sel[:, None] + j - lv[:, None] + 1)
+            valid = j < (lv + rv)[:, None]
+            cb.append(toks[sel])
+            xb.append(np.where(valid, toks[np.clip(cpos, 0, stream_len - 1)],
+                               0).astype(np.int32))
+            nb.append((lv + rv).astype(np.int32))
+        scatter_sets.append({
+            "centers": jnp.asarray(np.stack(cb), jnp.int32),
+            "contexts": jnp.asarray(np.stack(xb), jnp.int32),
+            "nctx": jnp.asarray(np.stack(nb), jnp.int32),
+        })
+
+    ldt = dt
+    banded_chunk = make_banded_chunk(W, P, NEG, dt, ldt, win_base, K,
+                                     seed=seed)
+
+    def scatter_chunk(params, feed, base_step, prob, alias):
+        negs = sample_negatives_hash(prob, alias, seed, base_step, (K, P))
+
+        def body(pr, inp):
+            c, x, nc, ng = inp
+            cmask = (jnp.arange(C)[None, :] < nc[:, None]).astype(jnp.float32)
+            new_p, m = cbow_step_shared_core(
+                pr, c, x, cmask, jnp.ones(B, jnp.float32), ng,
+                jnp.float32(0.025), NEG, "exact", dt, ldt,
+                with_metrics=False)
+            return new_p, m.loss
+
+        return jax.lax.scan(body, params, (
+            feed["centers"], feed["contexts"], feed["nctx"], negs))
+
+    runners = {}
+    for name, fn, sets in (("scatter (B*C rows)", scatter_chunk, scatter_sets),
+                           ("banded (prefix sums)", banded_chunk, banded_sets)):
+        f = jax.jit(fn, donate_argnums=(0,))
+
+        def run(f=f, sets=sets):
+            return time_chunked(
+                f,
+                lambda: EmbeddingPair(syn0_0 + 0, syn1_0 + 0),
+                lambda i: (sets[i % n_sets], np.int32(100 + i), prob, alias),
+                n_lo=2, n_hi=8,
+                # losses are elided — the fetch must depend on the params carry
+                fetch=lambda c, out: c.syn0[0, 0].astype(jnp.float32))
+        runners[name] = run
+
+    times = {k: [] for k in runners}
+    for _ in range(args.repeats):
+        for name, run in runners.items():
+            spc = run()
+            times[name].append(spc / K * 1e3)
+    ex_per_step = {"scatter (B*C rows)": float(B),
+                   "banded (prefix sums)": banded_live}
+    print(f"\nCBOW step A/B (B={B}, pool={P}, window={W}, {args.dtype}, "
+          f"median of {args.repeats} interleaved repeats):", file=sys.stderr)
+    meds = {}
+    for name, ts in times.items():
+        med = float(np.median(ts))
+        meds[name] = med
+        ex = ex_per_step[name]
+        print(f"  {name:24s} median {med:8.3f} ms/step  "
+              f"[{min(ts):8.3f} .. {max(ts):8.3f}]  "
+              f"{ex / (med / 1e3):13,.0f} examples/s "
+              f"({ex:,.0f} real ex/step)", file=sys.stderr)
+    sc = ex_per_step["scatter (B*C rows)"] / meds["scatter (B*C rows)"]
+    bd = ex_per_step["banded (prefix sums)"] / meds["banded (prefix sums)"]
+    print(f"  banded/scatter examples/s ratio: {bd / sc:.2f}x", file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--dtype", default="f32", choices=["f32", "bf16"])
     ap.add_argument("--b", type=int, default=65536)
     ap.add_argument("--pool", type=int, default=256)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--cbow", action="store_true",
+                    help="A/B the banded vs scatter CBOW step instead")
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--v", type=int, default=V)
+    ap.add_argument("--d", type=int, default=D)
     args = ap.parse_args()
+    if args.cbow:
+        if args.window < 2:
+            ap.error("--cbow needs --window >= 2: the reference's legacy "
+                     "asymmetric window draws b = nextInt(1) = 0 at window=1, "
+                     "which emits no contexts at all (the config path refuses "
+                     "cbow_update='banded' there for the same reason)")
+        run_cbow_ab(args)
+        return
     B, P = args.b, args.pool
 
     import jax
