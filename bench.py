@@ -30,6 +30,9 @@ throttles the host producer):
     cpu-torch — identical step math on the host CPU at the SAME batch as e2e, so
         vs_baseline is one honest basis: TPU end-to-end vs CPU compute-only loop
         (the CPU number has no host pipeline, which *flatters* the baseline).
+    host rows — tools/hostbench.py small tier (interleaved serial-vs-parallel
+        medians): producer_tokens_per_sec, ckpt_save_s/ckpt_load_s/export_s,
+        vocab_build_s/alias_build_s — the ISSUE-3 host data-plane trajectory.
 
 Timing: two-point slopes over donated, data-dependent chunk chains with a final
 device→host fetch (tools/microbench.py) — block_until_ready lies through the
@@ -563,6 +566,19 @@ def main() -> None:
     except Exception as e:
         log(f"V=1M scaling rows failed: {type(e).__name__}: {e}")
 
+    # host data-plane rows (ISSUE-3): producer tokens/s + checkpoint/export/
+    # cold-start wall clock via the interleaved hostbench harness, so
+    # BENCH_r06+ tracks the host trajectory alongside the step/e2e rows
+    host = {}
+    try:
+        import hostbench
+        # hostbench.run (not .main): the bench's contract is ONE JSON line on
+        # stdout, so the host row merges into the result instead of printing
+        host = hostbench.run(["--scale", "small",
+                              "--workers", str(min(os.cpu_count() or 1, 8))])
+    except Exception as e:
+        log(f"host-path rows failed: {type(e).__name__}: {e}")
+
     try:
         cpu_pps = bench_cpu_torch(B_MAIN)
     except Exception as e:
@@ -613,6 +629,15 @@ def main() -> None:
             if E2E_POOL in cbow_banded_rows else None),
         "cbow_banded_step_ms": (round(cbow_banded_rows[E2E_POOL][1], 3)
                                 if E2E_POOL in cbow_banded_rows else None),
+        # host data plane (tools/hostbench.py small tier, interleaved medians)
+        "producer_tokens_per_sec": host.get("producer_tokens_per_sec"),
+        "producer_speedup": host.get("producer_speedup"),
+        "ckpt_save_s": host.get("ckpt_save_s"),
+        "ckpt_save_speedup": host.get("ckpt_save_speedup"),
+        "ckpt_load_s": host.get("ckpt_load_s"),
+        "export_s": host.get("export_s"),
+        "vocab_build_s": host.get("vocab_build_s"),
+        "alias_build_s": host.get("alias_build_s"),
     }
     print(json.dumps(result))
 

@@ -247,6 +247,57 @@ class Word2VecConfig:
                                     # subsample keep ratio (targeting ~93% pair-slot fill;
                                     # overflow pairs are dropped and counted)
 
+    # --- parallel host data plane (PERF.md §10; no reference analog — the
+    # reference gets host parallelism from Spark partitions, mllib:345,428) ---
+    producer_workers: int = 1       # feed-producer thread pool width. 1 (default) =
+                                    # the serial producer, bit-identical to every
+                                    # prior release. >1 fans the per-slab pair/token
+                                    # generation (and, on multi-segment device
+                                    # feeds, the per-segment block streams) across
+                                    # this many threads — numpy releases the GIL in
+                                    # the hot loops, so production genuinely
+                                    # parallelizes. The stream is position-keyed
+                                    # (hashrng), so ANY worker count produces the
+                                    # bit-identical stream (tested); the knob only
+                                    # changes throughput. Sized to the host: ~4 on
+                                    # an 8-core host feeding a co-located device
+                                    # (PERF.md §5: the serial producer tops out at
+                                    # 9.5M pairs/s against a 12.4-13.2M pairs/s step)
+    io_workers: int = 1             # checkpoint/export I/O thread pool width. 1
+                                    # (default) = serial writes/reads. >1 fans
+                                    # independent file writes, shard reads, digest
+                                    # verification, and export block formatting
+                                    # across this many threads (train/checkpoint.py,
+                                    # models/word2vec.py) and parallelizes the
+                                    # cold-start builds (vocab counting slabs,
+                                    # alias-table partitions). Outputs are
+                                    # byte-identical at ANY worker count — the knob
+                                    # only changes wall clock. Hashing always
+                                    # happens in the same pass as the write
+                                    # (single-pass digests; this is unconditional,
+                                    # it needs no workers). One CROSS-RELEASE
+                                    # caveat, worker-independent: round 8's
+                                    # vectorized alias builder (ops/sampler.py)
+                                    # produces a DIFFERENT (equally exact,
+                                    # deterministic) table than rounds <= 7 at any
+                                    # worker count, so the realized negative-sample
+                                    # stream differs from prior releases —
+                                    # distribution unchanged (tested), PERF.md §10
+    sharded_prefetch: bool = True   # multi-process device-feed runs: stage each
+                                    # round's allgather + assembly + device put one
+                                    # round ahead on a background thread so the
+                                    # wire transfer overlaps device compute (the
+                                    # single-process _stage_to_device analog). The
+                                    # stager and the main loop alternate under a
+                                    # strict ticket handshake, so every process
+                                    # keeps ONE deterministic program-launch order
+                                    # (allgather_r, touch_r, dispatch_r, ...) — the
+                                    # invariant that makes cross-host collectives
+                                    # deadlock-free (see trainer._one_ahead_iter).
+                                    # False = the pre-round-8 consumer-thread put.
+                                    # No effect single-process or at
+                                    # prefetch_chunks=0
+
     # --- fault tolerance (docs/robustness.md; no reference analog — the
     # reference leans on Spark task re-execution, SURVEY §5) ---
     nonfinite_policy: str = "halt"  # what the trainer does when the params carry goes
@@ -420,6 +471,14 @@ class Word2VecConfig:
         if self.tokens_per_step < 0:
             raise ValueError(
                 f"tokens_per_step must be nonnegative but got {self.tokens_per_step}")
+        if self.producer_workers < 1:
+            raise ValueError(
+                f"producer_workers must be >= 1 (1 = serial producer) "
+                f"but got {self.producer_workers}")
+        if self.io_workers < 1:
+            raise ValueError(
+                f"io_workers must be >= 1 (1 = serial I/O) "
+                f"but got {self.io_workers}")
         if self.nonfinite_policy not in ("halt", "rollback", "none"):
             raise ValueError(
                 f"nonfinite_policy must be 'halt', 'rollback', or 'none' "

@@ -148,11 +148,17 @@ def block_pairs_native(
     shard: int,
     token_base: int,
     legacy_asymmetric_window: bool,
+    n_threads: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Drop-in replacement for ``pipeline._block_pairs`` (same stream, bit-identical).
 
     The caller is the pipeline's producer thread; the C++ side fans out over
-    sentence ranges and releases the GIL for the whole call (ctypes does)."""
+    sentence ranges and releases the GIL for the whole call (ctypes does).
+    ``n_threads`` overrides :func:`default_threads` (0 = default) — the
+    parallel slab producer divides the thread budget across its concurrent
+    calls so pools never multiply (pipeline.epoch_batches). The emitted
+    stream is deterministic at ANY thread count (ranges are position-keyed
+    and written to disjoint output slices)."""
     lib = _load()
     assert lib is not None, "call native_available() first"
     N = int(tokens.shape[0])
@@ -175,7 +181,7 @@ def block_pairs_native(
         ctypes.c_uint32(seed & 0xFFFFFFFF), ctypes.c_uint32(iteration & 0xFFFFFFFF),
         ctypes.c_uint32(shard & 0xFFFFFFFF),
         ctypes.c_uint64(token_base),
-        default_threads(),
+        int(n_threads) if n_threads > 0 else default_threads(),
         centers.ctypes.data, contexts.ctypes.data, clock.ctypes.data,
         cap, ctypes.byref(kept))
     if n < 0:  # cannot happen under the documented cap bound; belt and braces

@@ -36,6 +36,41 @@ import numpy as np
 from glint_word2vec_tpu.data.vocab import Vocabulary
 
 
+def ordered_pool_map(fn, jobs: Iterable, workers: int, ahead: int = 2):
+    """Map ``fn`` over ``jobs`` on a thread pool, yielding results in job order.
+
+    The host feed's parallelism primitive (PERF.md §10): every job is a pure
+    function of its inputs (the streams are position-keyed — hashrng — not
+    sequential-RNG), so running them concurrently and consuming in submission
+    order yields the bit-identical stream at ANY worker count. ``workers <= 1``
+    degrades to a plain serial loop (no pool, no thread — exactly the
+    pre-round-8 producer). At most ``workers + ahead`` jobs are in flight, so
+    a slow consumer bounds memory.
+    """
+    if workers <= 1:
+        for job in jobs:
+            yield fn(job)
+        return
+    import collections
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=workers,
+                              thread_name_prefix="glint-feed-worker")
+    pending: "collections.deque" = collections.deque()
+    try:
+        cap = workers + ahead
+        for job in jobs:
+            pending.append(pool.submit(fn, job))
+            if len(pending) >= cap:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+    finally:
+        while pending:
+            pending.pop().cancel()
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
 def stream_rng(seed: int, iteration: int, shard: int) -> np.random.Generator:
     """The batch stream's RNG: deterministic per (seed, iteration, shard) — the analog
     of the reference's XORShift reseed ``seed ^ ((idx+1)<<16) ^ ((-k-1)<<8)``
@@ -316,6 +351,7 @@ def epoch_batches(
     flush_last: bool = True,
     block_words: int = 1_000_000,
     backend: str = "auto",   # "auto" | "numpy" | "native" (C++ generator if built)
+    producer_workers: int = 1,
 ) -> Iterator[PairBatch]:
     """One iteration's stream of fixed-shape pair batches for one data shard.
 
@@ -328,6 +364,17 @@ def epoch_batches(
     (:func:`_block_pairs`) or handed to the multithreaded native generator
     (``native/pairgen.cpp``, bit-identical stream) — the host must outrun a TPU
     consuming millions of pairs/s.
+
+    ``producer_workers > 1`` fans the per-slab generation across a thread pool
+    (:func:`ordered_pool_map`): every slab's output is a pure function of
+    (tokens, lengths, token_base) under the position-keyed hashrng draws, so
+    the merged stream is bit-identical to the serial one at any worker count —
+    only the batching/clock accumulation below stays serial. The NATIVE
+    backend already fans each call over ``default_threads()`` C++ threads, so
+    the pooled path DIVIDES that budget across the concurrent calls
+    (``n_threads = default_threads() // workers``) — pools compose instead of
+    multiplying into N×M oversubscription; the native stream is deterministic
+    at any thread count.
     """
     if backend == "auto":
         from glint_word2vec_tpu.data.native import native_available
@@ -344,17 +391,32 @@ def epoch_batches(
         rng.shuffle(order)
     batcher = PairBatcher(pairs_per_batch, num_streams=3)
     words_base = 0   # kept words fully consumed in prior blocks
-    token_base = 0   # raw tokens consumed in prior blocks (position-key base)
     words_seen = 0
+    native_threads = 0
+    if use_native and producer_workers > 1:
+        from glint_word2vec_tpu.data.native import default_threads
+        native_threads = max(1, default_threads() // producer_workers)
 
-    for block in iter_sentence_slabs(sentences, order, block_words):
+    def slab_jobs():
+        token_base = 0  # raw tokens consumed in prior blocks (position-key base)
+        for block in iter_sentence_slabs(sentences, order, block_words):
+            yield block, token_base
+            token_base += sum(int(s.shape[0]) for s in block)
+
+    def run_slab(job):
+        block, token_base = job
         tokens = np.concatenate(block) if len(block) > 1 else block[0]
         lengths = np.fromiter((s.shape[0] for s in block), np.int64, len(block))
-        gen = block_pairs_native if use_native else _block_pairs
-        c, x, clock, kept = gen(
-            tokens, lengths, keep, window, seed, iteration, shard, token_base,
-            legacy_asymmetric_window)
-        token_base += int(tokens.shape[0])
+        if use_native:
+            return block_pairs_native(
+                tokens, lengths, keep, window, seed, iteration, shard,
+                token_base, legacy_asymmetric_window,
+                n_threads=native_threads)
+        return _block_pairs(tokens, lengths, keep, window, seed, iteration,
+                            shard, token_base, legacy_asymmetric_window)
+
+    for c, x, clock, kept in ordered_pool_map(
+            run_slab, slab_jobs(), producer_workers):
         # The reference counts *subsampled* words into its decay clock (mllib:414); the
         # per-pair clock credits words as their pairs are actually emitted, so alpha
         # advances per batch, not per block.
@@ -581,12 +643,14 @@ def epoch_batches_cbow(
     shuffle: bool = True,
     legacy_asymmetric_window: bool = True,
     block_words: int = 1_000_000,
+    producer_workers: int = 1,
 ) -> Iterator[CbowBatch]:
     """CBOW analog of :func:`epoch_batches`: fixed-shape [B, 2·window] context
     batches, block-vectorized (:func:`_block_cbow`) with the same position-keyed
     hashrng stream — deterministic per (seed, iteration, shard), no per-sentence
     Python loop, and sharded exactly like the skip-gram feed (the multi-process
-    allgather protocol consumes either)."""
+    allgather protocol consumes either). ``producer_workers``: same per-slab
+    thread-pool fan-out (and bit-identity contract) as :func:`epoch_batches`."""
     B = int(pairs_per_batch)
     rng = stream_rng(seed, iteration, shard)
     keep = keep_probabilities(
@@ -596,15 +660,24 @@ def epoch_batches_cbow(
         rng.shuffle(order)
     batcher = PairBatcher(B, num_streams=4)
     words_base = 0
-    token_base = 0
     words_seen = 0
-    for block in iter_sentence_slabs(sentences, order, block_words):
+
+    def slab_jobs():
+        token_base = 0
+        for block in iter_sentence_slabs(sentences, order, block_words):
+            yield block, token_base
+            token_base += sum(int(s.shape[0]) for s in block)
+
+    def run_slab(job):
+        block, token_base = job
         tokens = np.concatenate(block) if len(block) > 1 else block[0]
         lengths = np.fromiter((s.shape[0] for s in block), np.int64, len(block))
-        c, x, nc, clock, kept = _block_cbow(
+        return _block_cbow(
             tokens, lengths, keep, window, seed, iteration, shard, token_base,
             legacy_asymmetric_window)
-        token_base += int(tokens.shape[0])
+
+    for c, x, nc, clock, kept in ordered_pool_map(
+            run_slab, slab_jobs(), producer_workers):
         batcher.add(c, x, nc, words_base + clock)
         words_base += kept
         for bc, bx, bn, bclock, n in batcher.drain():

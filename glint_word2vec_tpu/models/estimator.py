@@ -59,7 +59,8 @@ class Word2Vec:
         if iter(sentences) is sentences:  # one-shot generator: must materialize
             sentences = list(sentences)
         if vocab is None:
-            vocab = build_vocab(sentences, cfg.min_count)
+            vocab = build_vocab(sentences, cfg.min_count,
+                                workers=cfg.io_workers)
         logger.info("vocabSize = %d, trainWordsCount = %d",
                     vocab.size, vocab.train_words_count)
         if encode_cache_dir is not None:
@@ -135,13 +136,17 @@ class Word2Vec:
             pv = pad_vocab_for_sharding(vocab.size, plan.num_model)
             pd = pad_dim_to_lanes(cfg.vector_size, cfg.pad_vector_to_lanes)
             syn0, syn1 = load_params_into_plan(
-                checkpoint_path, plan, pv, pd, dtype=np.dtype(cfg.param_dtype))
+                checkpoint_path, plan, pv, pd, dtype=np.dtype(cfg.param_dtype),
+                io_workers=cfg.io_workers)
             if syn1 is None:
                 raise ValueError("checkpoint has no syn1; cannot resume training")
             streamed = EmbeddingPair(syn0, syn1)
             data = None
         else:
-            data = load_model(checkpoint_path, header=header)
+            # io_workers from the LIVE (override-applied) config — the saved
+            # value reflects the writing host, not this one
+            data = load_model(checkpoint_path, header=header,
+                              io_workers=cfg.io_workers)
         if isinstance(sentences, EncodedCorpus):
             encoded = sentences
         elif encode_cache_dir is not None:

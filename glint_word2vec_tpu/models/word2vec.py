@@ -256,7 +256,7 @@ class Word2VecModel:
                 else:
                     words.append(None)
                     rows.append(jnp.asarray(q, jnp.float32))
-            scores, idxs = _cosine_topk_batch(
+            scores, idxs = _topk_dispatch(
                 self._full0, self._norms, jnp.stack(rows), k, self.num_words)
             for word, srow, irow in zip(words, np.asarray(scores),
                                         np.asarray(idxs)):
@@ -305,7 +305,8 @@ class Word2VecModel:
         return list(self.vocab.words), np.asarray(self.syn0)
 
     def export_word2vec(self, path: str, binary: bool = False,
-                        batch_size: int = 65536) -> None:
+                        batch_size: int = 65536,
+                        io_workers: Optional[int] = None) -> None:
         """Write the classic word2vec vectors file — the ecosystem interop the
         reference's ``toLocal`` delivers by producing a stock Spark model
         (mllib:651-662): gensim ``KeyedVectors.load_word2vec_format``, fastText
@@ -314,23 +315,54 @@ class Word2VecModel:
         Format (word2vec.c's writer): header line ``"<vocab> <dim>\\n"``; then per
         word, ``word`` + ``' '`` + (text: space-joined decimals + ``'\\n'``;
         binary: dim little-endian float32s followed by ``'\\n'``). Streams in row
-        blocks — no full-matrix host copy beyond one block."""
+        blocks — no full-matrix host copy beyond the in-flight blocks.
+
+        ``io_workers`` (default ``config.io_workers``) runs the byte
+        formatting of ~4k-row sub-chunks on a thread pool overlapped with the
+        serial in-order file write (pipeline.ordered_pool_map) — small jobs
+        keep the in-flight memory bounded (large whole-block jobs measurably
+        REGRESSED under allocator churn, hostbench). Device fetches stay on
+        the calling thread, and the bytes written are identical at any worker
+        count."""
         self._check_alive()
+        import io
+
+        from glint_word2vec_tpu.data.pipeline import ordered_pool_map
+        if io_workers is None:
+            io_workers = getattr(self.config, "io_workers", 1)
         D = int(self.syn0.shape[1])
-        with open(path, "wb") as f:
-            f.write(f"{self.num_words} {D}\n".encode())
+        sub = max(1, min(batch_size, 4096))
+
+        def jobs():
             for start in range(0, self.num_words, batch_size):
                 stop = min(start + batch_size, self.num_words)
                 block = np.asarray(self.syn0[start:stop], np.float32)
-                if binary:
-                    for i in range(stop - start):
-                        f.write(self.vocab.words[start + i].encode() + b" ")
-                        f.write(block[i].astype("<f4").tobytes())
-                        f.write(b"\n")
-                else:
-                    for i in range(stop - start):
-                        vec = " ".join(repr(float(x)) for x in block[i])
-                        f.write(f"{self.vocab.words[start + i]} {vec}\n".encode())
+                for lo in range(start, stop, sub):
+                    hi = min(lo + sub, stop)
+                    yield lo, block[lo - start:hi - start]
+
+        words = self.vocab.words
+
+        def format_chunk(job) -> bytes:
+            lo, rows = job
+            buf = io.BytesIO()
+            if binary:
+                raw = rows.astype("<f4")
+                for i in range(rows.shape[0]):
+                    buf.write(words[lo + i].encode())
+                    buf.write(b" ")
+                    buf.write(raw[i].tobytes())
+                    buf.write(b"\n")
+            else:
+                for i in range(rows.shape[0]):
+                    vec = " ".join(repr(float(x)) for x in rows[i])
+                    buf.write(f"{words[lo + i]} {vec}\n".encode())
+            return buf.getvalue()
+
+        with open(path, "wb") as f:
+            f.write(f"{self.num_words} {D}\n".encode())
+            for data in ordered_pool_map(format_chunk, jobs(), io_workers):
+                f.write(data)
 
     # -- persistence (G9/C13) ----------------------------------------------------------
 
@@ -344,7 +376,8 @@ class Word2VecModel:
 
     @classmethod
     def load(cls, path: str, plan: Optional[MeshPlan] = None,
-             verify: bool = True) -> "Word2VecModel":
+             verify: bool = True,
+             io_workers: Optional[int] = None) -> "Word2VecModel":
         """Load a saved model; ``plan`` retargets the arrays onto a different mesh — the
         analog of the reference's load-onto-different-PS-topology overloads
         (mllib:696-725, ml:584-599).
@@ -358,7 +391,11 @@ class Word2VecModel:
         ``verify=False`` skips the digest (re-)hash on both layouts — for
         callers that just verified (e.g. :meth:`load_latest`), or for skipping
         the extra sequential shard read on a trusted very large row-shards
-        checkpoint."""
+        checkpoint.
+
+        ``io_workers``: thread fan-out for digest hashing and shard reads on
+        THIS host (default: the worker count recorded in the checkpoint's
+        config — pass your own on hosts that differ from the writer's)."""
         header = None
         if plan is not None:
             header = ckpt.load_model_header(path)
@@ -367,11 +404,13 @@ class Word2VecModel:
                     header["words"], header["counts"])
                 Vp = pad_vocab_for_sharding(vocab.size, plan.num_model)
                 syn0, syn1 = ckpt.load_params_into_plan(
-                    path, plan, Vp, header["vector_size"], verify=verify)
+                    path, plan, Vp, header["vector_size"], verify=verify,
+                    io_workers=io_workers)
                 return cls(vocab=vocab, syn0=syn0, syn1=syn1,
                            config=header["config"], plan=plan,
                            train_state=header["train_state"])
-        data = ckpt.load_model(path, header=header, verify=verify)
+        data = ckpt.load_model(path, header=header, verify=verify,
+                               io_workers=io_workers)
         vocab = Vocabulary.from_words_and_counts(data["words"], data["counts"])
         return cls(
             vocab=vocab,
@@ -417,6 +456,20 @@ class Word2VecModel:
 from functools import partial
 
 
+@partial(jax.jit, static_argnames=("valid_rows",))
+def _cosine_batch(syn0: jax.Array, norms: jax.Array, queries: jax.Array,
+                  valid_rows: int) -> jax.Array:
+    """The [Q, V] masked cosine matrix of :func:`_cosine_topk_batch` without
+    the top-k — the shared front half of the device and CPU top-k routes."""
+    qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
+    q = queries / jnp.maximum(qn, 1e-12)
+    dots = q @ syn0.T                                          # [Q, V]
+    cos = jnp.where(norms[None, :] > 0,
+                    dots / jnp.maximum(norms[None, :], 1e-12), 0.0)
+    return jnp.where(jnp.arange(cos.shape[1])[None, :] < valid_rows,
+                     cos, -jnp.inf)
+
+
 @partial(jax.jit, static_argnames=("k", "valid_rows"))
 def _cosine_topk_batch(syn0: jax.Array, norms: jax.Array, queries: jax.Array,
                        k: int, valid_rows: int) -> Tuple[jax.Array, jax.Array]:
@@ -426,11 +479,61 @@ def _cosine_topk_batch(syn0: jax.Array, norms: jax.Array, queries: jax.Array,
     norms with zero-norm → 0 (mllib:601-609), batched device top-k instead of
     the client-side BoundedPriorityQueue scan (mllib:611-619). Rows past
     valid_rows are sharding padding, excluded outright."""
-    qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
-    q = queries / jnp.maximum(qn, 1e-12)
-    dots = q @ syn0.T                                          # [Q, V]
-    cos = jnp.where(norms[None, :] > 0,
-                    dots / jnp.maximum(norms[None, :], 1e-12), 0.0)
-    cos = jnp.where(jnp.arange(cos.shape[1])[None, :] < valid_rows,
-                    cos, -jnp.inf)
-    return jax.lax.top_k(cos, k)
+    return jax.lax.top_k(
+        _cosine_batch(syn0, norms, queries, valid_rows), k)
+
+
+# CPU route tiling: queries are sub-chunked so the fetched [q, V] score
+# block stays under ~512 MB of host RAM
+_CPU_TOPK_SCORE_BYTES = 512 << 20
+
+
+def _cpu_topk_row(row: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-k of one score row: O(V) selection + a k-element sort, scratch
+    bounded to one float copy of the row (``np.partition``). Tie handling is
+    EXACT to ``lax.top_k``: everything strictly above the k-th value is in,
+    and entries EQUAL to it fill the remaining slots in ascending index order
+    (a plain ``argpartition`` leaves that boundary choice arbitrary — it
+    returned different neighbors than the device route on tied scores)."""
+    V = row.shape[0]
+    if k >= V:
+        cand = np.arange(V)
+    else:
+        kth = np.partition(row, V - k)[V - k]        # the k-th largest value
+        above = np.flatnonzero(row > kth)
+        need = k - above.shape[0]
+        ties = np.flatnonzero(row == kth)[:need]     # lowest tied indices win
+        cand = np.concatenate([above, ties])
+    sc = row[cand]
+    order = np.lexsort((cand, -sc))
+    return sc[order], cand[order]
+
+
+def _topk_dispatch(syn0: jax.Array, norms: jax.Array, queries: jax.Array,
+                   k: int, valid_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Route the cosine top-k (PERF.md §10). Default everywhere:
+    ``lax.top_k`` in the same dispatch as the matmul. The host route —
+    fetch scores in ~512 MB sub-chunks, rank with chunked ``np.argpartition``
+    (:func:`_cpu_topk_row`), bit-identical results tie-order included
+    (tested) — exists for CPU backends whose XLA top-k lowers to a per-row
+    SORT (round 5 measured >30 min for 64 queries at V=10M, PERF.md §6, which
+    bricked CPU serving at scale). That pathology did NOT reproduce under the
+    current jaxlib — re-measured at 6.4 s for the same shape, beating the
+    host route 2-3x at every shape tried (§10) — so the host route is opt-in:
+    set ``GLINT_CPU_TOPK=argpartition`` on toolchains that still exhibit the
+    sort lowering."""
+    import os
+    if (jax.default_backend() != "cpu"
+            or os.environ.get("GLINT_CPU_TOPK") != "argpartition"):
+        s, i = _cosine_topk_batch(syn0, norms, queries, k, valid_rows)
+        return np.asarray(s), np.asarray(i)
+    Q, V = queries.shape[0], syn0.shape[0]
+    qsub = max(1, min(Q, _CPU_TOPK_SCORE_BYTES // max(V * 4, 1)))
+    scores = np.empty((Q, k), np.float32)
+    idxs = np.empty((Q, k), np.int64)
+    for lo in range(0, Q, qsub):
+        cos = np.asarray(_cosine_batch(
+            syn0, norms, queries[lo:lo + qsub], valid_rows))
+        for r in range(cos.shape[0]):
+            scores[lo + r], idxs[lo + r] = _cpu_topk_row(cos[r], k)
+    return scores, idxs

@@ -40,12 +40,74 @@ class AliasTable(NamedTuple):
         return self.prob.shape[0]
 
 
-def build_alias_table(counts: np.ndarray, power: float = 0.75) -> AliasTable:
+# Fixed partition fan-out of the parallel alias build. A CONSTANT (never a
+# function of the worker count), so the table is deterministic per
+# (counts, power) — a worker knob that changed the realized negative-sample
+# stream would make throughput settings quality-relevant.
+_ALIAS_PARTITIONS = 16
+_ALIAS_PARTITION_MIN_V = 1 << 18
+
+
+def _alias_pair_sweep(scaled: np.ndarray, prob: np.ndarray, alias: np.ndarray,
+                      small: np.ndarray, large: np.ndarray):
+    """Vose pairing over the given small/large index queues, vectorized by
+    CUMULATIVE MATCHING: one round assigns EVERY coverable small bucket to a
+    large donor by aligning the cumulative deficit (1 − scaled[small]) against
+    the cumulative surplus (scaled[large] − 1) with a searchsorted — O(V log V)
+    across a handful of rounds, vs the old one-small-per-large round pairing
+    whose 10k+ rounds of queue concatenation dominated the 10M-vocab build
+    (PERF.md §10). A donor pushed below residual 1 demotes to the small queue
+    (classic Vose), and the fp endgame — total remaining surplus smaller than
+    the first deficit — falls back to one literal Vose pairing round, which
+    absorbs the rounding imbalance exactly like the old builder. Mutates
+    prob/alias/scaled in place; returns the leftover (small, large) queues
+    (numerically ≈1 entries, finalized by the caller).
+
+    Exactness: any pairing order yields an exact table — correctness only
+    needs each bucket's kept probability plus its inbound alias mass to equal
+    ``scaled`` — and both branches maintain that invariant; the construction
+    is deterministic (fixed queue orders, no RNG)."""
+    while small.size and large.size:
+        d = 1.0 - scaled[small]
+        j = np.searchsorted(np.cumsum(scaled[large] - 1.0), np.cumsum(d),
+                            side="left")
+        assigned = j < large.size
+        if assigned.any():
+            sa, ja = small[assigned], j[assigned]
+            prob[sa] = scaled[sa]
+            alias[sa] = large[ja]
+            take = np.bincount(ja, weights=d[assigned], minlength=large.size)
+            scaled[large] -= take
+            now_small = scaled[large] < 1.0
+            small = np.concatenate([small[~assigned], large[now_small]])
+            large = large[~now_small]
+        else:
+            k = min(small.size, large.size)
+            s, small = small[:k], small[k:]
+            l = large[:k]
+            prob[s] = scaled[s]
+            alias[s] = l
+            scaled[l] -= 1.0 - scaled[s]
+            now_small = scaled[l] < 1.0
+            small = np.concatenate([small, l[now_small]])
+            large = np.concatenate([l[~now_small], large[k:]])
+    return small, large
+
+
+def build_alias_table(counts: np.ndarray, power: float = 0.75,
+                      workers: int = 1) -> AliasTable:
     """Build alias tables for p(w) ∝ counts[w]^power (classic word2vec 3/4 power).
 
-    Host-side Vose construction, vectorized: each round pairs k small buckets with k
-    distinct large buckets at once (every element is finalized exactly once, so total work
-    is O(V) array ops across a handful of rounds — fast enough to rebuild at 10M vocab).
+    Host-side Vose construction, vectorized by cumulative matching
+    (:func:`_alias_pair_sweep`). Above ``_ALIAS_PARTITION_MIN_V`` rows the
+    build is PARTITIONED: a fixed ``_ALIAS_PARTITIONS``-way strided split (the
+    stride interleaves the Zipf head so every partition gets a balanced
+    small/large mix) is swept per partition — independently, on ``workers``
+    threads when ``workers > 1`` (numpy releases the GIL in the hot ops) —
+    and the per-partition leftovers merge through one final sweep. The
+    partition count is a constant, never the worker count, so the table is
+    deterministic per (counts, power) at ANY ``workers``; partitions touch
+    disjoint index sets, so concurrent in-place writes never overlap.
     """
     counts = np.asarray(counts, dtype=np.float64)
     if counts.ndim != 1 or counts.size == 0:
@@ -59,18 +121,29 @@ def build_alias_table(counts: np.ndarray, power: float = 0.75) -> AliasTable:
     prob = np.ones(V, dtype=np.float64)
     alias = np.arange(V, dtype=np.int64)
 
-    small = np.flatnonzero(scaled < 1.0)
-    large = np.flatnonzero(scaled >= 1.0)
-    while small.size and large.size:
-        k = min(small.size, large.size)
-        s, small = small[:k], small[k:]
-        l = large[:k]
-        prob[s] = scaled[s]
-        alias[s] = l
-        scaled[l] -= 1.0 - scaled[s]
-        now_small = l[scaled[l] < 1.0]
-        large = np.concatenate([l[scaled[l] >= 1.0], large[k:]])
-        small = np.concatenate([small, now_small])
+    if V >= _ALIAS_PARTITION_MIN_V:
+        P = _ALIAS_PARTITIONS
+
+        def sweep_partition(c: int):
+            idx = np.arange(c, V, P)
+            sc = scaled[idx]
+            return _alias_pair_sweep(
+                scaled, prob, alias, idx[sc < 1.0], idx[sc >= 1.0])
+
+        if workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=min(workers, P),
+                    thread_name_prefix="glint-alias") as pool:
+                leftovers = list(pool.map(sweep_partition, range(P)))
+        else:
+            leftovers = [sweep_partition(c) for c in range(P)]
+        small = np.concatenate([s for s, _ in leftovers])
+        large = np.concatenate([l for _, l in leftovers])
+    else:
+        small = np.flatnonzero(scaled < 1.0)
+        large = np.flatnonzero(scaled >= 1.0)
+    small, large = _alias_pair_sweep(scaled, prob, alias, small, large)
     # leftovers are numerically ≈1: keep their own index
     prob[small] = 1.0
     prob[large] = 1.0

@@ -77,6 +77,63 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+_GATHER_JIT = None
+
+
+def _gather_plumbing():
+    """(mesh sharding for per-process slices, replicated-output identity jit) of
+    the cross-process gather — built once; shapes recompile per feed geometry,
+    which is constant over a run."""
+    global _GATHER_JIT
+    if _GATHER_JIT is None:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devices = np.array(jax.devices()).reshape(
+            jax.process_count(), jax.local_device_count())
+        mesh = Mesh(devices, ("processes", "local_devices"))
+        _GATHER_JIT = (
+            NamedSharding(mesh, P("processes")),
+            jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P())),
+        )
+    return _GATHER_JIT
+
+
+def allgather_start(host_tree):
+    """Split-phase ``multihost_utils.process_allgather(tiled=False)``: launch
+    the gather program for a pytree of per-process host arrays and return the
+    (async) global jax.Arrays; :func:`allgather_fetch` blocks for the stacked
+    numpy result (leading [process_count] axis, exactly the process_allgather
+    layout).
+
+    Why split: the one-round-ahead feed stager (trainer._one_ahead_iter) must
+    LAUNCH the next round's gather at a pinned point in the cross-host
+    program-launch order — before the current round's step dispatch — and only
+    later block for its bytes, so the gather's wire transfer and the host-side
+    decode overlap device compute instead of serializing after it.
+    Single-process: no program at all, the "handle" is the stacked numpy array
+    (makes the staged code path testable without a pod)."""
+    if not is_multiprocess():
+        return jax.tree.map(
+            lambda x: np.expand_dims(np.asarray(x), 0), host_tree)
+    sharding, ident = _gather_plumbing()
+
+    def start(x):
+        h = np.expand_dims(np.asarray(x), 0)
+        bufs = [jax.device_put(h, d) for d in jax.local_devices()]
+        garr = jax.make_array_from_single_device_arrays(
+            (jax.process_count(),) + h.shape[1:], sharding, bufs)
+        return ident(garr)
+
+    return jax.tree.map(start, host_tree)
+
+
+def allgather_fetch(handles):
+    """Block for and decode the result of :func:`allgather_start`."""
+    if not is_multiprocess():
+        return handles
+    return jax.tree.map(
+        lambda a: np.asarray(a.addressable_data(0)), handles)
+
+
 def put_global(sharding, host_arrays: Dict[str, np.ndarray]):
     """Place a dict of full (global-shape) host arrays onto sharding(s) that may span
     processes. ``sharding`` is either one sharding for every array or a dict keyed

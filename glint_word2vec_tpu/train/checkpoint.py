@@ -83,6 +83,57 @@ def _sha256_file(path: str) -> str:
     return h.hexdigest()
 
 
+class _HashingWriter:
+    """File-object proxy that SHA-256-hashes every byte as it is written —
+    the single-pass digest path (PERF.md §10): writers used to write each
+    file and then RE-READ it through :func:`_sha256_file`, one full extra
+    I/O pass over multi-GB matrices."""
+
+    def __init__(self, f):
+        self._f = f
+        self.sha = hashlib.sha256()
+
+    def write(self, data) -> int:
+        self.sha.update(data)
+        return self._f.write(data)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def tell(self) -> int:
+        return self._f.tell()
+
+
+def _save_npy_hashed(path: str, arr: np.ndarray) -> str:
+    """``np.save`` through a hashing proxy: returns the file's SHA-256 from
+    the same pass that wrote it."""
+    with open(path, "wb") as f:
+        w = _HashingWriter(f)
+        np.save(w, arr)
+    return w.sha.hexdigest()
+
+
+def _save_words_hashed(path: str, words: List[str]) -> str:
+    with open(path, "wb") as f:
+        w = _HashingWriter(f)
+        for word in words:
+            w.write((word + "\n").encode("utf-8"))
+    return w.sha.hexdigest()
+
+
+def _run_io(tasks, workers: int) -> list:
+    """Run independent no-arg I/O callables, returning their results in task
+    order — a thin eager adapter over the feed plane's
+    :func:`..data.pipeline.ordered_pool_map` (ONE pool primitive to
+    maintain). ``workers <= 1`` runs them serially on the calling thread;
+    outputs never depend on the worker count, only wall clock does
+    (config.io_workers)."""
+    from glint_word2vec_tpu.data.pipeline import ordered_pool_map
+    tasks = list(tasks)
+    return list(ordered_pool_map(
+        lambda t: t(), tasks, min(workers, len(tasks))))
+
+
 def _format_version(base: int, train_state: Optional["TrainState"]) -> int:
     if train_state is not None and train_state.shard_progress is not None:
         return SHARD_PROGRESS_FORMAT_VERSION
@@ -157,7 +208,13 @@ def save_model(
     into place, so a crash mid-save never corrupts an existing checkpoint (the whole point
     of ``checkpoint_every_steps``-style periodic saves). Every data file's SHA-256 rides
     in ``metadata.json["digests"]`` so readers (and :func:`load_latest_valid`) can tell
-    a torn or bit-rotted checkpoint from a good one."""
+    a torn or bit-rotted checkpoint from a good one.
+
+    I/O plane (PERF.md §10): digests are computed IN the write pass
+    (:class:`_HashingWriter` — one sequential pass per file, not write + re-
+    read), and the four independent file writes fan out over
+    ``config.io_workers`` threads. The bytes on disk and the digest map are
+    identical at any worker count."""
     bad = [w for w in words if (not w) or ("\n" in w)]
     if bad:
         raise ValueError(
@@ -170,22 +227,23 @@ def save_model(
         shutil.rmtree(tmp)
     os.makedirs(tmp)
     try:
-        digests: Dict[str, str] = {}
-
         def stage(name: str) -> str:
             return os.path.join(tmp, name)
 
-        with open(stage("words"), "w", encoding="utf-8") as f:
-            for w in words:
-                f.write(w + "\n")
-        np.save(stage("counts.npy"), np.asarray(counts, dtype=np.int64))
         syn0 = np.asarray(syn0, dtype=np.float32)
-        np.save(stage("syn0.npy"), syn0)
+        tasks = [
+            lambda: _save_words_hashed(stage("words"), words),
+            lambda: _save_npy_hashed(stage("counts.npy"),
+                                     np.asarray(counts, dtype=np.int64)),
+            lambda: _save_npy_hashed(stage("syn0.npy"), syn0),
+        ]
+        names = ["words", "counts.npy", "syn0.npy"]
         if syn1 is not None:
-            np.save(stage("syn1.npy"), np.asarray(syn1, dtype=np.float32))
-        for name in ("words", "counts.npy", "syn0.npy", "syn1.npy"):
-            if os.path.exists(stage(name)):
-                digests[name] = _sha256_file(stage(name))
+            tasks.append(lambda: _save_npy_hashed(
+                stage("syn1.npy"), np.asarray(syn1, dtype=np.float32)))
+            names.append("syn1.npy")
+        digests: Dict[str, str] = dict(
+            zip(names, _run_io(tasks, getattr(config, "io_workers", 1))))
         faults.crash_point("save:arrays-written")
         meta = {
             "format_version": _format_version(DENSE_FORMAT_VERSION, train_state),
@@ -213,14 +271,19 @@ def save_model(
     faults.corrupt_checkpoint(path)
 
 
-def _write_array_shards(dirpath: str, arr) -> Dict[str, str]:
+def _write_array_shards(dirpath: str, arr, workers: int = 1) -> Dict[str, str]:
     """Write the row ranges THIS process owns (replica 0 only) as individual .npy
     files. ``arr`` is a (possibly multi-process) row-sharded jax.Array; no full-array
     host materialization happens — each shard's ``.data`` is device-local. The
     filenames carry the row ranges; readers list the directory (no manifest).
-    Returns {checkpoint-relative path: sha256} for the files this process wrote."""
+    Returns {checkpoint-relative path: sha256} for the files this process wrote.
+
+    Each shard hashes in its own write pass (:class:`_HashingWriter`) and the
+    independent shard writes — device→host fetch included — fan out over
+    ``workers`` threads; the digest map is assembled in shard order, so bytes
+    and metadata are identical at any worker count."""
     os.makedirs(dirpath, exist_ok=True)
-    digests: Dict[str, str] = {}
+    jobs = []  # (relative name, task) in shard order
     for sh in arr.addressable_shards:
         if sh.replica_id != 0:
             continue  # rows replicated over the data axis: first replica writes
@@ -233,10 +296,14 @@ def _write_array_shards(dirpath: str, arr) -> Dict[str, str]:
                 "row-shards layout requires row sharding (full rows per shard); got "
                 f"column slice {cols} — use the dense layout for other shardings")
         fname = f"rows-{start:010d}-{stop:010d}.npy"
-        np.save(os.path.join(dirpath, fname), np.asarray(sh.data))
-        rel = f"{os.path.basename(dirpath)}/{fname}"
-        digests[rel] = _sha256_file(os.path.join(dirpath, fname))
-    return digests
+
+        def task(sh=sh, fname=fname):
+            return _save_npy_hashed(os.path.join(dirpath, fname),
+                                    np.asarray(sh.data))
+
+        jobs.append((f"{os.path.basename(dirpath)}/{fname}", task))
+    return dict(zip([rel for rel, _ in jobs],
+                    _run_io([t for _, t in jobs], workers)))
 
 
 def save_model_sharded(
@@ -287,13 +354,16 @@ def save_model_sharded(
         os.makedirs(tmp)
     if multi:
         multihost_utils.sync_global_devices("glint-ckpt-staged")
+    io_workers = getattr(config, "io_workers", 1)
     try:
         # shard lists are NOT collected into metadata: readers list the directory, and
         # the filenames carry the row ranges (a cross-process reduce would buy nothing)
-        digests = _write_array_shards(os.path.join(tmp, "syn0.shards"), syn0)
+        digests = _write_array_shards(os.path.join(tmp, "syn0.shards"), syn0,
+                                      workers=io_workers)
         if syn1 is not None:
             digests.update(
-                _write_array_shards(os.path.join(tmp, "syn1.shards"), syn1))
+                _write_array_shards(os.path.join(tmp, "syn1.shards"), syn1,
+                                    workers=io_workers))
         # per-process digest sidecars ride the shared filesystem (the same
         # contract the shard files themselves rely on); process 0 merges them
         # into metadata after the write barrier — cheaper and simpler than
@@ -310,13 +380,11 @@ def save_model_sharded(
                     with open(os.path.join(tmp, name), encoding="utf-8") as f:
                         digests.update(json.load(f))
                     os.unlink(os.path.join(tmp, name))
-            with open(os.path.join(tmp, "words"), "w", encoding="utf-8") as f:
-                for w in words:
-                    f.write(w + "\n")
-            np.save(os.path.join(tmp, "counts.npy"),
-                    np.asarray(counts, dtype=np.int64))
-            digests["words"] = _sha256_file(os.path.join(tmp, "words"))
-            digests["counts.npy"] = _sha256_file(os.path.join(tmp, "counts.npy"))
+            digests["words"] = _save_words_hashed(
+                os.path.join(tmp, "words"), words)
+            digests["counts.npy"] = _save_npy_hashed(
+                os.path.join(tmp, "counts.npy"),
+                np.asarray(counts, dtype=np.int64))
             meta = {
                 "format_version": _format_version(SHARDED_FORMAT_VERSION,
                                                   train_state),
@@ -399,25 +467,32 @@ class ShardedMatrixReader:
                     f"under {dirpath!r}")
             prev = stop
 
-    def read(self, start: int, stop: int) -> np.ndarray:
+    def read(self, start: int, stop: int, workers: int = 1) -> np.ndarray:
         """Rows [start, stop) assembled from the overlapping shard files (mmap-backed,
-        so only the requested pages are touched)."""
+        so only the requested pages are touched). ``workers > 1`` copies the
+        per-shard row ranges concurrently (disjoint destination slices, so the
+        result is identical at any worker count)."""
         out = np.empty((stop - start, self.cols), dtype=self.dtype)
-        for s, e, fname in self._spans:
+
+        def copy_span(span):
+            s, e, fname = span
             lo, hi = max(start, s), min(stop, e)
             if lo >= hi:
-                continue
+                return
             m = self._undo_void(
                 np.load(os.path.join(self.dirpath, fname), mmap_mode="r"))
             out[lo - start:hi - start] = m[lo - s:hi - s]
+
+        _run_io([lambda sp=sp: copy_span(sp) for sp in self._spans], workers)
         return out
 
-    def read_all(self) -> np.ndarray:
-        return self.read(0, self.rows)
+    def read_all(self, workers: int = 1) -> np.ndarray:
+        return self.read(0, self.rows, workers=workers)
 
 
 def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
-                          dtype=np.float32, verify: bool = False):
+                          dtype=np.float32, verify: bool = False,
+                          io_workers: Optional[int] = None):
     """Stream a row-shards checkpoint straight onto a target mesh (which may differ
     from the one that wrote it — the reference's load-onto-new-PS-topology path,
     mllib:696-725): each device's row block is read from the mmap'd shard files by a
@@ -435,8 +510,12 @@ def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
         meta = json.load(f)
     if meta.get("layout") != "row-shards":
         raise ValueError(f"{path!r} is not a row-shards checkpoint")
+    if io_workers is None:
+        # fallback only — the RESUMING run's live config should set this (the
+        # saved value reflects the writing host, not the loading one)
+        io_workers = int(meta.get("config", {}).get("io_workers", 1))
     if verify:
-        _verify_digests(path, meta)
+        _verify_digests(path, meta, workers=io_workers)
     V, Dr = meta["vocab_size"], meta["vector_size"]
 
     def make(name: str):
@@ -452,7 +531,7 @@ def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
             block = np.zeros((stop - start, padded_dim), dtype=dtype)
             lo, hi = start, min(stop, V)  # rows beyond the real vocab stay zero
             if lo < hi:
-                src = reader.read(lo, hi)
+                src = reader.read(lo, hi, workers=io_workers)
                 block[:hi - lo, :min(Dr, padded_dim)] = \
                     src[:, :min(Dr, padded_dim)]
             cols = idx[1] if len(idx) > 1 else slice(None)
@@ -464,17 +543,24 @@ def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
     return make("syn0"), make("syn1")
 
 
-def _verify_digests(path: str, meta: Dict[str, Any]) -> None:
+def _verify_digests(path: str, meta: Dict[str, Any],
+                    workers: int = 1) -> None:
     """Check every recorded SHA-256 digest against the on-disk bytes.
-    Checkpoints written before the digest map existed pass vacuously."""
+    Checkpoints written before the digest map existed pass vacuously.
+    ``workers > 1`` hashes the files concurrently (config.io_workers);
+    failures are reported in sorted-name order either way."""
     digests = meta.get("digests") or {}
-    for rel, want in sorted(digests.items()):
-        fp = os.path.join(path, rel.replace("/", os.sep))
-        if not os.path.exists(fp):
+    items = sorted(digests.items())
+    for rel, _ in items:
+        if not os.path.exists(os.path.join(path, rel.replace("/", os.sep))):
             raise CheckpointCorruptError(
                 f"checkpoint {path!r}: {rel!r} is recorded in the digest map "
                 f"but missing on disk — torn or partially deleted checkpoint")
-        got = _sha256_file(fp)
+    got_all = _run_io(
+        [lambda rel=rel: _sha256_file(
+            os.path.join(path, rel.replace("/", os.sep)))
+         for rel, _ in items], workers)
+    for (rel, want), got in zip(items, got_all):
         if got != want:
             raise CheckpointCorruptError(
                 f"checkpoint {path!r}: {rel!r} content digest {got[:12]}… does "
@@ -482,13 +568,13 @@ def _verify_digests(path: str, meta: Dict[str, Any]) -> None:
                 f"write, or hand-edited); refusing to load it")
 
 
-def verify_checkpoint(path: str) -> Dict[str, Any]:
+def verify_checkpoint(path: str, io_workers: int = 1) -> Dict[str, Any]:
     """Integrity audit of one checkpoint directory without loading matrices
     into device memory: metadata parses, the format version is readable, every
     required data file for the layout exists, shard spans are gapless, and all
     recorded digests match the bytes on disk. Returns the parsed metadata.
     Raises :class:`CheckpointCorruptError` (or ``FileNotFoundError`` when no
-    metadata exists at all)."""
+    metadata exists at all). ``io_workers > 1`` hashes files concurrently."""
     meta_path = os.path.join(path, "metadata.json")
     if not os.path.exists(meta_path):
         raise FileNotFoundError(f"no metadata.json under {path!r}")
@@ -520,7 +606,7 @@ def verify_checkpoint(path: str) -> Dict[str, Any]:
             raise CheckpointCorruptError(
                 f"checkpoint {path!r}: required file {name!r} missing — "
                 f"partial or torn checkpoint")
-    _verify_digests(path, meta)
+    _verify_digests(path, meta, workers=io_workers)
     return meta
 
 
@@ -640,7 +726,8 @@ def load_model_header(path: str) -> Dict[str, Any]:
 
 
 def load_model(path: str, header: Optional[Dict[str, Any]] = None,
-               verify: bool = True) -> Dict[str, Any]:
+               verify: bool = True,
+               io_workers: Optional[int] = None) -> Dict[str, Any]:
     """Read a saved model directory. Returns dict with words, counts, syn0, syn1 (may be
     None), config, train_state. Mirrors the reference's load contract (mllib:710-725:
     read /words in row order, load matrix shards, rebuild model).
@@ -653,25 +740,41 @@ def load_model(path: str, header: Optional[Dict[str, Any]] = None,
     writer recorded — a bit-flipped or torn checkpoint raises
     :class:`CheckpointCorruptError` instead of silently loading garbage rows.
     Costs one extra sequential read of the files; this full-materialization
-    path is host-RAM-bound anyway (pre-digest checkpoints pass vacuously)."""
+    path is host-RAM-bound anyway (pre-digest checkpoints pass vacuously).
+
+    ``io_workers`` (default: the saved config's ``io_workers``) fans digest
+    hashing, per-shard reads, and the syn0/syn1 loads across a thread pool —
+    the loaded arrays are identical at any worker count."""
     if header is None:
         header = load_model_header(path)
+    if io_workers is None:
+        io_workers = getattr(header["config"], "io_workers", 1)
     if verify:
         meta_path = os.path.join(path, "metadata.json")
         with open(meta_path, "r", encoding="utf-8") as f:
-            _verify_digests(path, json.load(f))
+            _verify_digests(path, json.load(f), workers=io_workers)
     words = header["words"]
     if header["layout"] == "row-shards":
         V, Dr = header["vocab_size"], header["vector_size"]
-        syn0 = ShardedMatrixReader(
-            os.path.join(path, "syn0.shards")).read(0, V)[:, :Dr]
         s1dir = os.path.join(path, "syn1.shards")
-        syn1 = (ShardedMatrixReader(s1dir).read(0, V)[:, :Dr]
-                if os.path.isdir(s1dir) else None)
+        # split the worker budget across the two matrices, each of which fans
+        # its own per-shard copies (disjoint destination slices)
+        per = max(1, io_workers // 2)
+        syn0, syn1 = _run_io(
+            [lambda: ShardedMatrixReader(
+                os.path.join(path, "syn0.shards")).read(
+                    0, V, workers=per)[:, :Dr],
+             lambda: (ShardedMatrixReader(s1dir).read(
+                 0, V, workers=per)[:, :Dr]
+                      if os.path.isdir(s1dir) else None)],
+            io_workers)
     else:
-        syn0 = np.load(os.path.join(path, "syn0.npy"))
         syn1_path = os.path.join(path, "syn1.npy")
-        syn1 = np.load(syn1_path) if os.path.exists(syn1_path) else None
+        syn0, syn1 = _run_io(
+            [lambda: np.load(os.path.join(path, "syn0.npy")),
+             lambda: (np.load(syn1_path) if os.path.exists(syn1_path)
+                      else None)],
+            io_workers)
     if syn0.shape[0] != len(words):
         raise ValueError(
             f"words sidecar has {len(words)} entries but syn0 has {syn0.shape[0]} rows")

@@ -157,6 +157,115 @@ class _threaded_iter:
             pass
 
 
+class _one_ahead_iter:
+    """Run a generator on a background thread exactly ONE item ahead of the
+    consumer, under an explicit ``ack()`` ticket: after delivering item r the
+    producer does not start producing item r+1 until the consumer acks r.
+
+    This is the multi-process staging primitive (PERF.md §10). Producing a
+    round launches device programs (the next round's allgather, the staging
+    touch) and consuming one launches more (the step dispatch, heartbeat
+    fetches, checkpoint collectives). Cross-host deadlock-freedom requires
+    every process to enqueue collective programs in the same order, so the
+    ticket serializes the two threads into ONE deterministic per-process
+    launch order — [stage_r, dispatch_r + bookkeeping_r, stage_{r+1}, ...] —
+    identical on every process because both sides are pure functions of
+    allgathered values. The overlap win survives: stage_{r+1}'s HOST work
+    (allgather result decode, feed assembly, device-put DMA) runs while chunk
+    r executes on device.
+
+    Generator exceptions re-raise at the consumer's ``next()``; ``close()``
+    unblocks and joins the producer."""
+
+    _DONE = object()
+
+    def __init__(self, gen):
+        import queue
+        import threading
+
+        self._out: "queue.Queue" = queue.Queue(maxsize=1)
+        self._ack: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._queue_mod = queue
+
+        def put_checked(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._out.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def wait_ack() -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._ack.get(timeout=0.1)
+                    return True
+                except queue.Empty:
+                    continue
+            return False
+
+        def run():
+            it = iter(gen)
+            try:
+                first = True
+                while True:
+                    # the ack gate sits BEFORE producing item r+1 (before
+                    # re-entering the generator), so stage r+1's program
+                    # launches come after the consumer's round-r launches
+                    # everywhere
+                    if not first and not wait_ack():
+                        return
+                    first = False
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        put_checked(self._DONE)
+                        return
+                    if not put_checked(item):
+                        return
+            except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+                put_checked(e)
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="glint-round-stager")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._out.get()
+        if item is self._DONE:
+            self._stop.set()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._stop.set()
+            raise item
+        return item
+
+    def ack(self) -> None:
+        self._ack.put(None)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            while True:
+                self._out.get_nowait()
+        except self._queue_mod.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class Trainer:
     """Owns the sharded embedding pair and runs the synchronous SGNS/CBOW loop."""
 
@@ -208,7 +317,8 @@ class Trainer:
             raise ValueError(
                 f"embedding_partition='cols' needs the padded vector dim "
                 f"{self.padded_dim} divisible by num_model={plan.num_model}")
-        self.table = build_alias_table(vocab.counts, config.sample_power)
+        self.table = build_alias_table(vocab.counts, config.sample_power,
+                                       workers=config.io_workers)
         # replicated device copies, passed into the jitted chunk as ARGUMENTS every
         # dispatch — closure-captured constants take a catastrophically slow gather
         # path on TPU (see ops/prng.py)
@@ -1028,7 +1138,8 @@ class Trainer:
             self.save_checkpoint(checkpoint_path)
         return self.params
 
-    def _device_seg_blocks(self, sentences: Sequence[np.ndarray], k: int, s: int):
+    def _device_seg_blocks(self, sentences: Sequence[np.ndarray], k: int, s: int,
+                           workers: Optional[int] = None):
         """[T]-token blocks of data segment s, iteration k, for the device pair
         generator — SUBSAMPLED on the host (same hashrng draws on raw ordinals as
         data/pipeline, vectorized over ~1M-raw-token slabs; a per-sentence Python
@@ -1041,7 +1152,12 @@ class Trainer:
 
         Deterministic per (seed, k, s) and independent of which process runs it —
         the property the sharded multi-process feed relies on (a 2-process run's
-        segment s is bit-identical to a single-process run's).
+        segment s is bit-identical to a single-process run's). ``workers``
+        (default ``config.producer_workers``) fans the per-slab subsample work
+        across a thread pool (pipeline.ordered_pool_map): the draws are keyed
+        by raw-token ordinals, so each slab is a pure function of its (slab,
+        ordinal base) job and the merged stream is bit-identical at any worker
+        count — only the T-boundary packing below stays serial.
 
         Banded-CBOW mode (self._block_halo > 0): the same kept stream is cut
         with a ±halo OVERLAP instead (pipeline.pack_halo_token_blocks) — blocks
@@ -1051,8 +1167,11 @@ class Trainer:
         from glint_word2vec_tpu.data.hashrng import (
             STREAM_SUBSAMPLE, hash_u01_at, stream_base)
         from glint_word2vec_tpu.data.pipeline import (
-            iter_sentence_slabs, pack_halo_token_blocks, stream_rng)
+            iter_sentence_slabs, ordered_pool_map, pack_halo_token_blocks,
+            stream_rng)
         cfg = self.config
+        if workers is None:
+            workers = cfg.producer_workers
         Sd = self.plan.num_data
         T = self._tokens_per_step
         tok_dt = self._pair_dtype
@@ -1063,29 +1182,39 @@ class Trainer:
             rng.shuffle(order)
         sub_base = stream_base(cfg.seed, STREAM_SUBSAMPLE, k, s)
 
-        def kept_slabs():
-            """(kept_tokens, sentence_start_flags) per ~1M-raw-token slab."""
+        def slab_jobs():
             raw_ord = 0
             for slab in iter_sentence_slabs(sentences, order):
-                tokens = np.concatenate(slab) if len(slab) > 1 else slab[0]
-                lens = np.fromiter(
-                    (x.shape[0] for x in slab), np.int64, len(slab))
-                n = tokens.shape[0]
-                sids = np.repeat(np.arange(len(slab)), lens)
-                if cfg.subsample_ratio > 0:
-                    u = hash_u01_at(sub_base, np.arange(
-                        raw_ord, raw_ord + n, dtype=np.uint64))
-                    m = u <= keep[tokens]
-                    ktoks, ksids = tokens[m], sids[m]
-                else:
-                    ktoks, ksids = tokens, sids
-                raw_ord += n
-                if ktoks.shape[0] == 0:
-                    continue
-                kstart = np.empty(ktoks.shape[0], bool)
-                kstart[0] = True
-                kstart[1:] = ksids[1:] != ksids[:-1]
-                yield ktoks.astype(tok_dt), kstart
+                yield slab, raw_ord
+                raw_ord += sum(int(x.shape[0]) for x in slab)
+
+        def run_slab(job):
+            """(kept_tokens, sentence_start_flags) of one ~1M-raw-token slab —
+            pure in (slab, raw ordinal base); None for an all-dropped slab."""
+            slab, raw_ord = job
+            tokens = np.concatenate(slab) if len(slab) > 1 else slab[0]
+            lens = np.fromiter(
+                (x.shape[0] for x in slab), np.int64, len(slab))
+            n = tokens.shape[0]
+            sids = np.repeat(np.arange(len(slab)), lens)
+            if cfg.subsample_ratio > 0:
+                u = hash_u01_at(sub_base, np.arange(
+                    raw_ord, raw_ord + n, dtype=np.uint64))
+                m = u <= keep[tokens]
+                ktoks, ksids = tokens[m], sids[m]
+            else:
+                ktoks, ksids = tokens, sids
+            if ktoks.shape[0] == 0:
+                return None
+            kstart = np.empty(ktoks.shape[0], bool)
+            kstart[0] = True
+            kstart[1:] = ksids[1:] != ksids[:-1]
+            return ktoks.astype(tok_dt), kstart
+
+        def kept_slabs():
+            for res in ordered_pool_map(run_slab, slab_jobs(), workers):
+                if res is not None:
+                    yield res
 
         if self._block_halo:
             yield from pack_halo_token_blocks(
@@ -1132,17 +1261,36 @@ class Trainer:
         joining — -1 means the segment already finished this iteration (empty
         from the start, no production cost). ``counts``: optional list updated
         in place with each segment's consumed-block total (skips included) —
-        the per-SEGMENT positions elastic resume persists."""
+        the per-SEGMENT positions elastic resume persists.
+
+        Parallelism (config.producer_workers > 1): with multiple segments the
+        per-segment block streams run on their own prefetching threads, gated
+        by a shared semaphore so at most ``producer_workers`` segments produce
+        concurrently (the ISSUE-3 multi-worker producer: segments are
+        independent and deterministic per (seed, k, s), and the merge below
+        consumes them in fixed segment order, so the joined step-row stream is
+        bit-identical to the serial one). Single-segment calls parallelize at
+        the slab level inside _device_seg_blocks instead."""
+        segs = list(segs)
         T = self._tokens_per_step
         tok_dt = self._pair_dtype
         nbytes = (T + 7) // 8
+        workers = self.config.producer_workers
+        multi_seg = workers > 1 and len(segs) > 1
+        # split the worker budget: up to `workers` segments produce at once
+        # (the semaphore below), and each segment's slab work gets the
+        # leftover share — with fewer segments than workers the slab fan-out
+        # uses the rest instead of idling (workers=8 over 2 segments → 2
+        # segment threads × 4 slab workers, not 2 × 1)
+        inner_workers = max(1, workers // len(segs)) if multi_seg else workers
         iters = []
         for i, s in enumerate(segs):
             skip = 0 if skips is None else skips[i]
             if skip < 0:
                 iters.append(iter(()))
                 continue
-            it = self._device_seg_blocks(sentences, k, s)
+            it = self._device_seg_blocks(sentences, k, s,
+                                         workers=inner_workers)
             consumed = 0
             for _ in range(skip):
                 if next(it, None) is None:
@@ -1157,30 +1305,56 @@ class Trainer:
             iters.append(it)
             if counts is not None:
                 counts[i] += consumed
-        while True:
-            rows = []
-            exp_kept = 0.0
-            exhausted = 0
-            for i, it in enumerate(iters):
-                blk = next(it, None)
-                if blk is None:
-                    exhausted += 1
-                    rows.append((np.zeros(T, tok_dt),
-                                 np.zeros(nbytes, np.uint8), 0, 0, 0.0))
-                else:
-                    rows.append(blk)
-                    exp_kept += blk[4]
-                    if counts is not None:
-                        counts[i] += 1
-            if exhausted == len(iters):
-                return
-            tokens = np.stack([r[0] for r in rows])
-            starts = np.stack([r[1] for r in rows])
-            nvalid = np.asarray([r[2] for r in rows], np.float32)
-            obase = np.asarray(
-                [[r[3] & 0xFFFFFFFF, r[3] >> 32] for r in rows],
-                np.uint32).view(np.int32)
-            yield (tokens, starts, nvalid, obase, exp_kept)
+        closers: List[_threaded_iter] = []
+        if multi_seg:
+            import threading
+            sem = threading.Semaphore(workers)
+            _DONE = object()
+
+            def gated(gen):
+                # hold the semaphore only while producing one block, so at
+                # most `workers` segment streams burn CPU at once
+                while True:
+                    with sem:
+                        item = next(gen, _DONE)
+                    if item is _DONE:
+                        return
+                    yield item
+
+            wrapped = []
+            for it in iters:
+                ti = _threaded_iter(gated(it), maxsize=2)
+                closers.append(ti)
+                wrapped.append(iter(ti))
+            iters = wrapped
+        try:
+            while True:
+                rows = []
+                exp_kept = 0.0
+                exhausted = 0
+                for i, it in enumerate(iters):
+                    blk = next(it, None)
+                    if blk is None:
+                        exhausted += 1
+                        rows.append((np.zeros(T, tok_dt),
+                                     np.zeros(nbytes, np.uint8), 0, 0, 0.0))
+                    else:
+                        rows.append(blk)
+                        exp_kept += blk[4]
+                        if counts is not None:
+                            counts[i] += 1
+                if exhausted == len(iters):
+                    return
+                tokens = np.stack([r[0] for r in rows])
+                starts = np.stack([r[1] for r in rows])
+                nvalid = np.asarray([r[2] for r in rows], np.float32)
+                obase = np.asarray(
+                    [[r[3] & 0xFFFFFFFF, r[3] >> 32] for r in rows],
+                    np.uint32).view(np.int32)
+                yield (tokens, starts, nvalid, obase, exp_kept)
+        finally:
+            for c in closers:
+                c.close()
 
     def _fit_device_feed(
         self,
@@ -1517,9 +1691,15 @@ class Trainer:
         mesh data degree % M == 0, including M=1 (the single-process device-feed
         path reads the same entries). The reference has no analog: its recovery
         story is Spark task retry against mutated PS state (SURVEY §5).
-        """
-        from jax.experimental import multihost_utils
 
+        STAGING (config.sharded_prefetch, PERF.md §10): with prefetching on,
+        the per-round allgather/assembly/device-put runs one round ahead on a
+        background thread under the _one_ahead_iter ticket handshake, which
+        pins ONE deterministic per-process program-launch order — the
+        determinism contract above is untouched because every staged value is
+        still a pure function of allgathered data; only WHEN the host does the
+        work moves.
+        """
         from glint_word2vec_tpu.data.hashrng import (
             STREAM_SUBSAMPLE, STREAM_WINDOW, stream_base)
         cfg = self.config
@@ -1597,43 +1777,59 @@ class Trainer:
         else:
             chunks = iter(local_stream())
 
-        cur_sprog = np.asarray(seg_state, np.int64)  # [spp, 2] last CONSUMED
-        # barrier state: the iteration currently training and its cumulative
-        # kept-word clock. On resume the within-iteration clock is rebuilt from
-        # the saved word count (exact to < 1 word — the int() truncation of the
-        # analytic iteration base; same approximation class as the saved clock
-        # itself, and resumed runs match uninterrupted ones to the suite's 1e-4
-        # standard, not bitwise)
-        round_iter = self.state.iteration
-        iter_kept = max(0.0, float(self.state.words_processed)
-                        - (round_iter - 1) * train_words)
-        held = None             # produced-but-not-yet-consumed local chunk
-        exhausted = False
+        # stage one round ahead (config.sharded_prefetch): the round generator
+        # below runs on a _one_ahead_iter thread and launches the NEXT round's
+        # allgather before yielding the current one, so the gather's wire
+        # transfer sits ahead of the step dispatch in the device queue and the
+        # host-side decode/assembly/put-DMA overlap chunk compute. The ticket
+        # handshake keeps one deterministic cross-host launch order:
+        # [gather_1, touch_1, gather_2], dispatch_1 + bookkeeping_1,
+        # [touch_2, gather_3], dispatch_2, ... — identical on every process.
+        staged = bool(cfg.sharded_prefetch and cfg.prefetch_chunks > 0)
         est_total = 0.0
         pairs_arrays: List[jax.Array] = []
         dropped_arrays: List[jax.Array] = []
         self._start_run_bookkeeping()
-        zero = dict(tokens=np.zeros((K, spp, T), tok_dt),
-                    starts=np.zeros((K, spp, nbytes), np.uint8),
-                    nvalid=np.zeros((K, spp), np.float32),
-                    obase=np.zeros((K, spp, 2), np.int32),
-                    kept=np.zeros(K, np.float32),
-                    sub_bases=np.zeros(spp, np.uint32),
-                    win_bases=np.zeros(spp, np.uint32))
-        try:
-            while True:
+
+        def round_stream():
+            from glint_word2vec_tpu.parallel.distributed import (
+                allgather_fetch, allgather_start)
+            cur_sprog = np.asarray(seg_state, np.int64)  # [spp, 2] last CONSUMED
+            # barrier state: the iteration currently training and its cumulative
+            # kept-word clock. On resume the within-iteration clock is rebuilt
+            # from the saved word count (exact to < 1 word — the int()
+            # truncation of the analytic iteration base; same approximation
+            # class as the saved clock itself, and resumed runs match
+            # uninterrupted ones to the suite's 1e-4 standard, not bitwise)
+            round_iter = self.state.iteration
+            iter_kept = max(0.0, float(self.state.words_processed)
+                            - (round_iter - 1) * train_words)
+            held = None         # produced-but-not-yet-consumed local chunk
+            exhausted = False
+            zero = dict(tokens=np.zeros((K, spp, T), tok_dt),
+                        starts=np.zeros((K, spp, nbytes), np.uint8),
+                        nvalid=np.zeros((K, spp), np.float32),
+                        obase=np.zeros((K, spp, 2), np.int32),
+                        kept=np.zeros(K, np.float32),
+                        sub_bases=np.zeros(spp, np.uint32),
+                        win_bases=np.zeros(spp, np.uint32))
+
+            def start_gather():
+                """Collect this process's next offer and LAUNCH (not fetch) its
+                allgather. The offer protocol is byte-identical to the
+                pre-staging loop; only the launch/fetch split is new."""
+                nonlocal held, exhausted
                 if held is None and not exhausted:
                     t0 = time.perf_counter()
                     held = next(chunks, None)
-                    self.host_wait_time += time.perf_counter() - t0
+                    if not staged:
+                        self.host_wait_time += time.perf_counter() - t0
                     if held is None:
                         exhausted = True
                 offer = held if held is not None else dict(
                     zero, iteration=int(cur_sprog[:, 0].max()),
                     sprog=cur_sprog, real=0)
-
-                t0 = time.perf_counter()
-                g = multihost_utils.process_allgather({
+                return allgather_start({
                     "tokens": offer["tokens"], "starts": offer["starts"],
                     "nvalid": offer["nvalid"], "obase": offer["obase"],
                     "kept": offer["kept"],
@@ -1643,15 +1839,24 @@ class Trainer:
                     "sprog": np.asarray(offer["sprog"], np.int64),
                     "alive": np.asarray([0 if exhausted else 1], np.int32),
                     "prog": cur_sprog,
-                })  # every leaf gains a leading [S] process axis
+                })
+
+            pending = start_gather()
+            while True:
+                t0 = time.perf_counter()
+                g = allgather_fetch(pending)  # leading [S] process axis
                 alive = g["alive"][:, 0] > 0                        # [S]
                 if not alive.any():
-                    break
+                    # every process observes the same all-dead round and stops
+                    # here; a pipelined gather for the round after may already
+                    # be launched — every process launched it identically, so
+                    # it executes consistently and nobody reads it
+                    return
                 # iteration barrier: this round trains the minimum live
                 # iteration; offers from a later iteration are NOT consumed —
                 # their segments ride as zeros (exactly the zero blocks the
-                # single-process stream pads exhausted segments with) and their
-                # owners re-offer them next round
+                # single-process stream pads exhausted segments with) and
+                # their owners re-offer them next round
                 round_it = int(g["iter"][alive, 0].min())
                 use = alive & (g["iter"][:, 0] == round_it)         # [S]
                 if round_it != round_iter:
@@ -1690,42 +1895,82 @@ class Trainer:
                 # real rows are prefixes; the longest prefix is the row count
                 real = int(g["real"][use, 0].max())
                 est_pairs = float(kept_step.sum()) * rate_per_kept
-                est_total += est_pairs
 
                 if cfg.feed_consistency_check:
                     self._assert_feed_consistent(
                         dict(arrays, sub=sub_bases, win=win_bases), meta)
                 stacked = put_global(self._chunk_shardings, arrays)
-                self.params, (metrics, dropped) = self._dispatch_step_fn(real)(
-                    self.params, stacked, meta,
-                    np.int32(self.global_step + 1),
-                    self._table_prob, self._table_alias,
-                    self._keep_prob_dev, sub_bases, win_bases)
-                self.dispatch_time += time.perf_counter() - t0
-                pairs_arrays.append(metrics.pairs)
-                dropped_arrays.append(dropped)
+                if staged:
+                    # force the upload DMA now, overlapped with chunk compute
+                    self._touch(stacked)
                 if use[pid] and held is not None:
                     cur_sprog = np.asarray(held["sprog"], np.int64)
                     held = None
-                # prog in THIS round's allgather predates the consumption above,
-                # so each SEGMENT's persisted position comes from its owner's
-                # offer if consumed, else from its last consumed snapshot — a
-                # held offer was not trained
+                # prog in THIS round's allgather predates the consumption
+                # above, so each SEGMENT's persisted position comes from its
+                # owner's offer if consumed, else from its last consumed
+                # snapshot — a held offer was not trained
                 prog = [[int(a), int(b)]
                         for s in range(S)
                         for a, b in (g["sprog"][s] if use[s] else g["prog"][s])]
+                if staged:
+                    # pipelining: LAUNCH the next round's gather before
+                    # yielding, so it precedes this round's dispatch in every
+                    # process's launch order and its transfer rides ahead of
+                    # the chunk in the device queue
+                    pending = start_gather()
+                else:
+                    self.dispatch_time += time.perf_counter() - t0
+                yield dict(
+                    stacked=stacked, meta=meta, real=real, est_pairs=est_pairs,
+                    sub_bases=sub_bases, win_bases=win_bases, round_it=round_it,
+                    words=int(clocks[max(real - 1, 0)]), prog=prog)
+                if not staged:
+                    pending = start_gather()
+
+        rounds = round_stream()
+        if staged:
+            rounds = _one_ahead_iter(rounds)
+        rounds_it = iter(rounds)
+        try:
+            while True:
+                t0 = time.perf_counter()
+                rnd = next(rounds_it, None)
+                if staged:
+                    self.host_wait_time += time.perf_counter() - t0
+                if rnd is None:
+                    break
+                t0 = time.perf_counter()
+                self.params, (metrics, dropped) = \
+                    self._dispatch_step_fn(rnd["real"])(
+                        self.params, rnd["stacked"], rnd["meta"],
+                        np.int32(self.global_step + 1),
+                        self._table_prob, self._table_alias,
+                        self._keep_prob_dev, rnd["sub_bases"],
+                        rnd["win_bases"])
+                self.dispatch_time += time.perf_counter() - t0
+                pairs_arrays.append(metrics.pairs)
+                dropped_arrays.append(dropped)
+                est_total += rnd["est_pairs"]
                 self._finish_round(
-                    real, est_pairs, meta[0], metrics,
+                    rnd["real"], rnd["est_pairs"], rnd["meta"][0], metrics,
                     TrainState(
-                        iteration=round_it,
-                        words_processed=int(clocks[max(real - 1, 0)]),
+                        iteration=rnd["round_it"],
+                        words_processed=rnd["words"],
                         # meaningless across segments — resume uses the
                         # per-segment shard_progress
                         batches_done=0,
-                        shard_progress=prog, shard_feed="tokens"),
+                        shard_progress=rnd["prog"], shard_feed="tokens"),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
+                if staged:
+                    # round fully consumed (dispatch + any heartbeat fetch /
+                    # checkpoint collectives launched) — release the stager
+                    rounds.ack()
         finally:
             self._stop_profiler()
+            closer = getattr(rounds, "close", None)
+            if closer is not None:
+                closer()
             closer = getattr(chunks, "close", None)
             if closer is not None:
                 closer()
@@ -1748,10 +1993,27 @@ class Trainer:
         (measured: a concurrent put+consume fully hides behind device compute,
         a consumer-thread put does not).
 
-        Single-process only: with multiple processes, a producer-thread dispatch
-        would race the main thread's step dispatch for cross-host program launch
-        order and can deadlock the collectives — multi-process feeds keep the
-        consumer-thread put (callers gate on process_count)."""
+        Single-process free-running only: with multiple processes, a
+        producer-thread dispatch would race the main thread's step dispatch for
+        cross-host program launch order and can deadlock the collectives — the
+        multi-process device-feed path instead stages through the
+        ``_one_ahead_iter`` ticket handshake (see _fit_device_feed_sharded),
+        which pins one deterministic launch order; the remaining multi-process
+        feeds keep the consumer-thread put."""
+        for chunk in chunks:
+            stacked = put_global(self._chunk_shardings, chunk["arrays"])
+            chunk["arrays"] = stacked
+            # retain the forcing op's output with the chunk (never fetched — a
+            # blocking fetch here stalls the producer behind the device queue,
+            # measured slower; the dispatch is enough to enqueue the upload)
+            chunk["_touch"] = self._touch(stacked)
+            yield chunk
+
+    def _touch(self, stacked):
+        """Dispatch a tiny consuming op over staged feed arrays so their
+        host→device upload is enqueued NOW (on the calling thread) instead of
+        lazily at step-dispatch time — the transfer-forcing half of
+        :meth:`_stage_to_device`, shared with the sharded round stager."""
         if not hasattr(self, "_touch_fn"):
             import operator
 
@@ -1763,14 +2025,7 @@ class Trainer:
                         arrays))
 
             self._touch_fn = jax.jit(touch)
-        for chunk in chunks:
-            stacked = put_global(self._chunk_shardings, chunk["arrays"])
-            chunk["arrays"] = stacked
-            # retain the forcing op's output with the chunk (never fetched — a
-            # blocking fetch here stalls the producer behind the device queue,
-            # measured slower; the dispatch is enough to enqueue the upload)
-            chunk["_touch"] = self._touch_fn(stacked)
-            yield chunk
+        return self._touch_fn(stacked)
 
     def _start_run_bookkeeping(self) -> None:
         self.rollbacks_performed = 0  # max_rollbacks is a per-fit() budget
@@ -2083,13 +2338,15 @@ class Trainer:
                         sentences, self.vocab, pairs_per_batch=b_local,
                         window=cfg.window, subsample_ratio=cfg.subsample_ratio,
                         seed=cfg.seed, iteration=k, shard=pid, num_shards=S,
-                        shuffle=cfg.shuffle)
+                        shuffle=cfg.shuffle,
+                        producer_workers=cfg.producer_workers)
                 else:
                     stream = epoch_batches(
                         sentences, self.vocab, pairs_per_batch=b_local,
                         window=cfg.window, subsample_ratio=cfg.subsample_ratio,
                         seed=cfg.seed, iteration=k, shard=pid, num_shards=S,
-                        shuffle=cfg.shuffle)
+                        shuffle=cfg.shuffle,
+                        producer_workers=cfg.producer_workers)
                 for b in stream:
                     ws = b.words_seen
                     if to_skip:  # exact resume: fast-forward already-trained batches
@@ -2215,7 +2472,7 @@ class Trainer:
         common = dict(
             pairs_per_batch=cfg.pairs_per_batch, window=cfg.window,
             subsample_ratio=cfg.subsample_ratio, seed=cfg.seed, iteration=iteration,
-            shuffle=cfg.shuffle)
+            shuffle=cfg.shuffle, producer_workers=cfg.producer_workers)
         # batches are prefix-masked by construction (PairBatcher pads only the tail),
         # so only the real count ships — the device rebuilds mask = (iota < real)
         if cfg.cbow:

@@ -1,0 +1,326 @@
+"""Host data-plane A/B harness: producer throughput + checkpoint/export/cold-start
+wall clock, serial vs parallel (PERF.md §10).
+
+Every comparison is an INTERLEAVED A/B (the PERF.md §3 methodology): the serial
+and parallel variants alternate within one process — [A, B, A, B, ...] for
+``--repeats`` rounds — and the reported numbers are per-variant medians, so
+allocator drift, page-cache warmth, and co-tenant noise hit both sides alike.
+Legacy checkpoint/alias baselines are reconstructed inline (write-then-rehash;
+the old round-pairing alias loop) so the single-pass/vectorization wins are
+measured against what actually shipped before, not just against workers=1.
+
+Tiers (``--scale``):
+    smoke   seconds-scale — wired into tier-1 (tests/test_parallel_host.py) so
+            the harness itself cannot rot; numbers are NOT meaningful perf
+    small   ~1 minute on a laptop
+    medium  the default measurement tier (~100 MB matrices)
+    large   the acceptance-criteria tier: >= 1 GB checkpoint matrix
+
+Prints exactly ONE JSON line on stdout; tables go to stderr. bench.py embeds
+the ``small`` tier's fields (producer_tokens_per_sec, ckpt_save_s, ckpt_load_s,
+export_s, vocab_build_s, alias_build_s) into its round JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCALES = {
+    # n_words, vocab, rows, dim, pairs_per_batch, repeats
+    "smoke": dict(n_words=120_000, vocab=3_000, rows=4_000, dim=64,
+                  pairs_per_batch=4096, repeats=3),
+    "small": dict(n_words=2_000_000, vocab=50_000, rows=65_536, dim=128,
+                  pairs_per_batch=65_536, repeats=3),
+    "medium": dict(n_words=8_000_000, vocab=200_000, rows=262_144, dim=384,
+                   pairs_per_batch=65_536, repeats=3),
+    "large": dict(n_words=16_000_000, vocab=1_000_000, rows=700_000, dim=384,
+                  pairs_per_batch=65_536, repeats=3),  # 700k x 384 f32 ≈ 1.07 GB
+}
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def interleaved(variants: dict, repeats: int) -> dict:
+    """Run {name: thunk} alternating for ``repeats`` rounds; per-name median
+    seconds. The thunks run in a fixed name order within each round."""
+    times = {name: [] for name in variants}
+    for _ in range(repeats):
+        for name, thunk in variants.items():
+            t0 = time.perf_counter()
+            thunk()
+            times[name].append(time.perf_counter() - t0)
+    return {name: float(np.median(ts)) for name, ts in times.items()}
+
+
+def make_corpus(n_words: int, vocab_size: int, sent_len: int = 40):
+    rng = np.random.default_rng(0)
+    zipf = 1.0 / (np.arange(vocab_size) + 10.0) ** 1.05
+    ids = rng.choice(vocab_size, size=n_words, p=zipf / zipf.sum())
+    words = np.char.add("w", ids.astype("U8"))
+    return [list(words[i:i + sent_len]) for i in range(0, n_words, sent_len)]
+
+
+def bench_vocab(sents, workers: int, repeats: int) -> dict:
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    res = interleaved({
+        "serial": lambda: build_vocab(sents, min_count=1),
+        "parallel": lambda: build_vocab(sents, min_count=1, workers=workers),
+    }, repeats)
+    log(f"vocab build:   serial {res['serial']:.3f}s  "
+        f"workers={workers} {res['parallel']:.3f}s  "
+        f"({res['serial'] / max(res['parallel'], 1e-9):.2f}x)")
+    return res
+
+
+def _alias_legacy(counts: np.ndarray, power: float = 0.75):
+    """The pre-round-8 alias builder verbatim (one-small-per-large round
+    pairing with queue concatenation) — the legacy baseline the vectorized
+    cumulative-matching sweep is measured against."""
+    counts = np.asarray(counts, dtype=np.float64)
+    weights = np.power(np.maximum(counts, 0.0), power)
+    V = counts.size
+    scaled = weights * (V / weights.sum())
+    prob = np.ones(V, dtype=np.float64)
+    alias = np.arange(V, dtype=np.int64)
+    small = np.flatnonzero(scaled < 1.0)
+    large = np.flatnonzero(scaled >= 1.0)
+    while small.size and large.size:
+        k = min(small.size, large.size)
+        s, small = small[:k], small[k:]
+        l = large[:k]
+        prob[s] = scaled[s]
+        alias[s] = l
+        scaled[l] -= 1.0 - scaled[s]
+        now_small = l[scaled[l] < 1.0]
+        large = np.concatenate([l[scaled[l] >= 1.0], large[k:]])
+        small = np.concatenate([small, now_small])
+    prob[small] = 1.0
+    prob[large] = 1.0
+    return prob, alias
+
+
+def bench_alias(vocab_size: int, workers: int, repeats: int) -> dict:
+    from glint_word2vec_tpu.ops.sampler import build_alias_table
+    counts = np.maximum(1e9 / (np.arange(vocab_size) + 10.0) ** 1.07, 5.0)
+    res = interleaved({
+        "legacy": lambda: _alias_legacy(counts),
+        "serial": lambda: build_alias_table(counts, workers=1),
+        "parallel": lambda: build_alias_table(counts, workers=workers),
+    }, repeats)
+    log(f"alias build (V={vocab_size:,d}): legacy {res['legacy']:.3f}s  "
+        f"sweep {res['serial']:.3f}s  sweep+workers={workers} "
+        f"{res['parallel']:.3f}s  "
+        f"({res['legacy'] / max(res['parallel'], 1e-9):.2f}x vs legacy)")
+    return res
+
+
+def bench_producer(sents, pairs_per_batch: int, workers: int,
+                   repeats: int) -> dict:
+    """Feed-producer throughput: drain one full epoch_batches iteration and
+    count RAW corpus tokens per second (the producer's input rate — the unit
+    PERF.md §5's 9.5M pairs/s producer ceiling is about, modulo the pair
+    expansion factor). Serial vs producer_workers=N, interleaved."""
+    from glint_word2vec_tpu.data.pipeline import encode_sentences, epoch_batches
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    vocab = build_vocab(sents, min_count=1)
+    enc = encode_sentences(sents, vocab, 1000)
+    n_tokens = sum(int(s.shape[0]) for s in enc)
+
+    def drain(w: int):
+        n = 0
+        for b in epoch_batches(enc, vocab, pairs_per_batch=pairs_per_batch,
+                               window=5, subsample_ratio=1e-3, seed=1,
+                               iteration=1, producer_workers=w,
+                               block_words=200_000):
+            n += b.num_real_pairs
+        return n
+
+    res = interleaved({
+        "serial": lambda: drain(1),
+        "parallel": lambda: drain(workers),
+    }, repeats)
+    out = {
+        "serial_tokens_per_sec": n_tokens / res["serial"],
+        "parallel_tokens_per_sec": n_tokens / res["parallel"],
+        "speedup": res["serial"] / max(res["parallel"], 1e-9),
+    }
+    log(f"producer:      serial {out['serial_tokens_per_sec']:,.0f} tok/s  "
+        f"workers={workers} {out['parallel_tokens_per_sec']:,.0f} tok/s  "
+        f"({out['speedup']:.2f}x)")
+    return out
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def bench_checkpoint(rows: int, dim: int, workers: int, repeats: int,
+                     workdir: str) -> dict:
+    """Checkpoint save/load/export A/B at a [rows, dim] f32 matrix pair.
+
+    save_legacy reconstructs the pre-round-8 writer cost shape exactly:
+    serial np.save of every file followed by a full re-read through sha256
+    (the two-pass digest). save_new is the shipped single-pass hashing writer
+    at io_workers=N. Load verifies digests both ways; export writes the
+    word2vec binary format."""
+    import jax.numpy as jnp
+
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.vocab import Vocabulary
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+    from glint_word2vec_tpu.train import checkpoint as ckpt
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(rows)]
+    counts = np.maximum(1e9 / (np.arange(rows) + 10.0) ** 1.07, 5.0).astype(
+        np.int64)
+    syn0 = rng.standard_normal((rows, dim), dtype=np.float32)
+    syn1 = rng.standard_normal((rows, dim), dtype=np.float32)
+    gb = 2 * syn0.nbytes / 1e9
+    log(f"checkpoint matrices: 2 x [{rows:,d}, {dim}] f32 = {gb:.2f} GB")
+    cfg_new = Word2VecConfig(vector_size=dim, io_workers=workers)
+    cfg_old = Word2VecConfig(vector_size=dim, io_workers=1)
+    p_new = os.path.join(workdir, "ck-new")
+    p_old = os.path.join(workdir, "ck-old")
+
+    def save_legacy():
+        # the old writer verbatim: serial write, then re-read to hash
+        if os.path.exists(p_old):
+            shutil.rmtree(p_old)
+        os.makedirs(p_old)
+        with open(os.path.join(p_old, "words"), "w", encoding="utf-8") as f:
+            for w in words:
+                f.write(w + "\n")
+        np.save(os.path.join(p_old, "counts.npy"), counts)
+        np.save(os.path.join(p_old, "syn0.npy"), syn0)
+        np.save(os.path.join(p_old, "syn1.npy"), syn1)
+        digests = {}
+        for name in ("words", "counts.npy", "syn0.npy", "syn1.npy"):
+            digests[name] = _sha256_file(os.path.join(p_old, name))
+        with open(os.path.join(p_old, "metadata.json"), "w") as f:
+            json.dump({"format_version": 1, "vocab_size": rows,
+                       "vector_size": dim, "digests": digests,
+                       "config": cfg_old.to_dict(auto_markers=False),
+                       "train_state": ckpt.TrainState(finished=True).to_dict(),
+                       "framework": "glint_word2vec_tpu"}, f)
+
+    def save_new():
+        ckpt.save_model(p_new, words, counts, syn0, syn1, cfg_new)
+
+    save = interleaved({"legacy": save_legacy, "new": save_new}, repeats)
+
+    load = interleaved({
+        "serial": lambda: ckpt.load_model(p_new, verify=True, io_workers=1),
+        "parallel": lambda: ckpt.load_model(p_new, verify=True,
+                                            io_workers=workers),
+    }, repeats)
+
+    vocab = Vocabulary.from_words_and_counts(words, counts)
+    model = Word2VecModel(vocab, jnp.asarray(syn0), config=cfg_new)
+    ex = os.path.join(workdir, "export.bin")
+    export = interleaved({
+        "serial": lambda: model.export_word2vec(ex, binary=True, io_workers=1),
+        "parallel": lambda: model.export_word2vec(ex, binary=True,
+                                                  io_workers=workers),
+    }, repeats)
+    model.stop()
+
+    log(f"ckpt save:     legacy(2-pass serial) {save['legacy']:.3f}s  "
+        f"new(1-pass, io_workers={workers}) {save['new']:.3f}s  "
+        f"({save['legacy'] / max(save['new'], 1e-9):.2f}x)")
+    log(f"ckpt load:     serial {load['serial']:.3f}s  "
+        f"io_workers={workers} {load['parallel']:.3f}s  "
+        f"({load['serial'] / max(load['parallel'], 1e-9):.2f}x)")
+    log(f"export (bin):  serial {export['serial']:.3f}s  "
+        f"io_workers={workers} {export['parallel']:.3f}s  "
+        f"({export['serial'] / max(export['parallel'], 1e-9):.2f}x)")
+    return {"save": save, "load": load, "export": export, "matrix_gb": gb}
+
+
+def run(argv=None) -> dict:
+    """Parse args, run the benches, return the result row WITHOUT printing —
+    the embeddable entry point (bench.py merges the row into its own single
+    stdout JSON line; only the CLI below prints)."""
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scale", choices=sorted(SCALES), default="medium")
+    ap.add_argument("--smoke", action="store_true",
+                    help="alias for --scale smoke (the tier-1 wiring)")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=None,
+                    help="interleaved repeats per variant (default: >= 3)")
+    ap.add_argument("--workdir", default=None,
+                    help="where checkpoint/export bytes land (default: a "
+                         "fresh temp dir, deleted afterwards)")
+    args = ap.parse_args(argv)
+    scale = "smoke" if args.smoke else args.scale
+    p = SCALES[scale]
+    repeats = max(args.repeats or p["repeats"], 1)
+    workers = args.workers
+    log(f"hostbench scale={scale} workers={workers} repeats={repeats} "
+        f"(host: {os.cpu_count()} cpus)")
+
+    sents = make_corpus(p["n_words"], p["vocab"])
+    vocab_res = bench_vocab(sents, workers, repeats)
+    alias_res = bench_alias(p["vocab"] if scale == "smoke" else
+                            max(p["vocab"], p["rows"]), workers, repeats)
+    prod_res = bench_producer(sents, p["pairs_per_batch"], workers, repeats)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="glint-hostbench-")
+    try:
+        ck = bench_checkpoint(p["rows"], p["dim"], workers, repeats, workdir)
+    finally:
+        if args.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    result = {
+        "scale": scale,
+        "workers": workers,
+        "repeats": repeats,
+        "cpus": os.cpu_count(),
+        "producer_tokens_per_sec": round(prod_res["parallel_tokens_per_sec"]),
+        "producer_tokens_per_sec_serial": round(
+            prod_res["serial_tokens_per_sec"]),
+        "producer_speedup": round(prod_res["speedup"], 3),
+        "ckpt_save_s": round(ck["save"]["new"], 4),
+        "ckpt_save_legacy_s": round(ck["save"]["legacy"], 4),
+        "ckpt_save_speedup": round(
+            ck["save"]["legacy"] / max(ck["save"]["new"], 1e-9), 3),
+        "ckpt_load_s": round(ck["load"]["parallel"], 4),
+        "ckpt_load_serial_s": round(ck["load"]["serial"], 4),
+        "export_s": round(ck["export"]["parallel"], 4),
+        "export_serial_s": round(ck["export"]["serial"], 4),
+        "ckpt_matrix_gb": round(ck["matrix_gb"], 3),
+        "vocab_build_s": round(vocab_res["parallel"], 4),
+        "vocab_build_serial_s": round(vocab_res["serial"], 4),
+        "alias_build_s": round(alias_res["parallel"], 4),
+        "alias_build_serial_s": round(alias_res["serial"], 4),
+        "alias_build_legacy_s": round(alias_res["legacy"], 4),
+    }
+    return result
+
+
+def main(argv=None) -> dict:
+    result = run(argv)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()
