@@ -94,6 +94,34 @@ class Word2VecConfig:
                                        # reference's thin-network regime, not ICI)
     mesh_shape: Optional[Tuple[int, int]] = None  # explicit (data, model) mesh; default derives
                                                   # from num_data_shards × num_model_shards
+    step_lowering: str = "gspmd"    # how the sharded SGNS step lowers onto the mesh:
+                                    # "gspmd" (default): one jitted program, GSPMD
+                                    # inserts whatever collectives it derives from the
+                                    # sharding constraints — the pre-round-9 behavior,
+                                    # bit-identical to it.
+                                    # "shard_map": the hand-lowered explicit schedule
+                                    # (ops/sgns_shard.py, docs/sharding.md) — each
+                                    # model shard gathers rows it owns (index −
+                                    # row_offset, OOB-masked) with ONE psum over the
+                                    # model axis assembling e_in/e_pos/pool rows, the
+                                    # backward applies OWNER-LOCAL scatters only (zero
+                                    # update bytes cross the model axis — the TPU
+                                    # analog of the reference's ship-indices-and-
+                                    # scalars collective schedule, CIKM'16), and the
+                                    # data axis exchanges the per-shard update payload
+                                    # with one all-gather. HLO-audited collective
+                                    # bytes: tools/collectives.py; mesh-shape A/B:
+                                    # tools/shard_ab.py. Identical math (f64 ~1e-12
+                                    # equivalence tested at every 8-device mesh
+                                    # shape); each lowering is run-to-run
+                                    # deterministic, but the two lowerings are NOT
+                                    # bit-identical to each other (different FP
+                                    # reduction orders). Shared-pool skip-gram rows
+                                    # layout only (pool > 0, no cbow/pallas/
+                                    # duplicate_scaling/cols — refused at
+                                    # construction). GSPMD stays the default until a
+                                    # hardware A/B lands (the audited collective
+                                    # profile is the evidence so far, PERF.md §7)
 
     # --- negative-sampling table (G7; mllib:81,234-244) ---
     unigram_table_size: int = 100_000_000  # kept for compat; the alias sampler is O(2·vocab)
@@ -282,7 +310,18 @@ class Word2VecConfig:
                                     # deterministic) table than rounds <= 7 at any
                                     # worker count, so the realized negative-sample
                                     # stream differs from prior releases —
-                                    # distribution unchanged (tested), PERF.md §10
+                                    # distribution unchanged (tested), PERF.md §10.
+                                    # SCOPE caveat for the vocab-counting slab fan-out:
+                                    # counting PYTHON string tokens under the GIL is
+                                    # pure contention — MEASURED 0.66x at workers=4
+                                    # (hostbench, PERF.md §10; Counter.update never
+                                    # releases the lock) — so build_vocab engages the
+                                    # slab pool only when data.vocab.
+                                    # parallel_counting_profitable() says the runtime
+                                    # can profit (free-threaded CPython). A session on
+                                    # a free-threaded host flips it by re-measuring
+                                    # there, not by editing a guess: the helper + its
+                                    # evidence live in one place (data/vocab.py)
     sharded_prefetch: bool = True   # multi-process device-feed runs: stage each
                                     # round's allgather + assembly + device put one
                                     # round ahead on a background thread so the
@@ -441,7 +480,8 @@ class Word2VecConfig:
                 # mean semantics exist only on the per-example scatter path
                 self.negative_pool = 0
             elif (self.pairs_per_batch < 4096 and not self.use_pallas
-                    and self.cbow_update != "banded"):
+                    and self.cbow_update != "banded"
+                    and self.step_lowering != "shard_map"):
                 # Small batches take the per-pair exact path (the reference's G3
                 # semantics): the shared pool's matmul amortization buys nothing at
                 # this scale, and shared negatives measurably cost quality on small
@@ -461,6 +501,51 @@ class Word2VecConfig:
             raise ValueError(
                 f"negative_pool must be nonnegative (or -1 for auto) "
                 f"but got {self.negative_pool}")
+        # --- step_lowering selection matrix (trainer._build_step dispatches on
+        # it; every unsupported combination is an ERROR here, never a silent
+        # fallback — same discipline as the CBOW matrix above):
+        #   shard_map × cbow              → refuse (the explicit schedule is the
+        #       shared-pool SGNS step only; CBOW keeps GSPMD)
+        #   shard_map × use_pallas        → refuse (pallas owns the whole step)
+        #   shard_map × duplicate_scaling → refuse (mean semantics need global
+        #       in-batch occurrence counts — a [V]-sized cross-shard psum the
+        #       schedule exists to avoid)
+        #   shard_map × negative_pool=0   → refuse (per-pair negatives re-create
+        #       the [B, n, D] row traffic; the schedule assembles ONE pool)
+        #   shard_map × cols              → refuse (owner-local row scatters are
+        #       the rows layout's property; cols owns columns, not rows)
+        if self.step_lowering not in ("gspmd", "shard_map"):
+            raise ValueError(
+                f"step_lowering must be 'gspmd' or 'shard_map' "
+                f"but got {self.step_lowering!r}")
+        if self.step_lowering == "shard_map":
+            if self.cbow:
+                raise ValueError(
+                    "step_lowering='shard_map' is implemented for the "
+                    "shared-pool skip-gram step only; CBOW runs under GSPMD "
+                    "(step_lowering='gspmd')")
+            if self.use_pallas:
+                raise ValueError(
+                    "step_lowering='shard_map' and use_pallas=True both claim "
+                    "the step lowering; the pallas kernel is single-device "
+                    "only — drop one")
+            if self.duplicate_scaling:
+                raise ValueError(
+                    "step_lowering='shard_map' does not support "
+                    "duplicate_scaling=True: mean-update semantics need global "
+                    "in-batch occurrence counts, a [V]-sized cross-shard psum "
+                    "the explicit schedule exists to avoid — use 'gspmd'")
+            if self.negative_pool == 0:
+                raise ValueError(
+                    "step_lowering='shard_map' requires the shared-pool "
+                    "estimator (negative_pool > 0, or -1 for auto at "
+                    "pairs_per_batch >= 4096); per-pair negatives "
+                    "(negative_pool=0) are GSPMD-path only")
+            if self.embedding_partition != "rows":
+                raise ValueError(
+                    "step_lowering='shard_map' is the rows-layout schedule "
+                    "(owner-local row scatters); embedding_partition="
+                    f"{self.embedding_partition!r} keeps GSPMD")
         if self.num_data_shards <= 0:
             raise ValueError(
                 f"num_data_shards must be positive but got {self.num_data_shards}")
@@ -495,10 +580,12 @@ class Word2VecConfig:
                 and any(k in kwargs for k in (
                     "pairs_per_batch", "negatives",
                     # these change which pool the AUTO rule resolves (banded
-                    # forces one at any batch size, cbow+duplicate_scaling
-                    # forces 0) — a frozen resolved value would trip the
-                    # selection-matrix refusals the user never opted into
-                    "cbow", "cbow_update", "duplicate_scaling", "use_pallas"))):
+                    # and shard_map force one at any batch size,
+                    # cbow+duplicate_scaling forces 0) — a frozen resolved
+                    # value would trip the selection-matrix refusals the user
+                    # never opted into
+                    "cbow", "cbow_update", "duplicate_scaling", "use_pallas",
+                    "step_lowering"))):
             # the pool was auto-derived under the OLD geometry/path — re-derive
             # it for the new one instead of freezing a now-wrong pool
             kwargs["negative_pool"] = -1

@@ -154,22 +154,43 @@ def build_vocab(sentences: Iterable[Sequence[str]], min_count: int = 5,
                 counter = collections.Counter(
                     dict(zip(words, (int(c) for c in counts))))
                 return Vocabulary.from_counter(counter, min_count)
-    if workers > 1 and not _gil_enabled():
-        # counting python tokens under the GIL is pure contention — measured
-        # 0.66x at workers=4 (hostbench) — so the thread fan-out engages only
-        # on free-threaded builds; count_words_parallel itself stays available
-        # (and identity-tested) for direct callers
+    if parallel_counting_profitable(workers):
         return Vocabulary.from_counter(
             count_words_parallel(sentences, workers), min_count)
     return Vocabulary.from_counter(count_words(sentences), min_count)
 
 
-def _gil_enabled() -> bool:
+def parallel_counting_profitable(workers: int = 2) -> bool:
+    """Should :func:`build_vocab` fan token counting across ``workers`` threads?
+
+    The ONE owner of this decision (config.py's ``io_workers`` note points
+    here). The evidence, so the next session on a different runtime re-measures
+    instead of guessing:
+
+    - Stock CPython: ``Counter.update`` over python string tokens never
+      releases the GIL, so the slab fan-out is pure contention — MEASURED
+      0.66× at ``workers=4`` on the hostbench small tier (PERF.md §10). A
+      GIL-releasing ``np.unique`` slab counter measured slower outright
+      (string sort O(n log n) vs hash counting O(n)). Verdict: False.
+    - Free-threaded CPython (3.13+ ``--disable-gil`` builds,
+      ``sys._is_gil_enabled() == False``): the contention argument vanishes
+      by construction; the fan-out is expected to scale like the other slab
+      pools (NOT yet measured — no free-threaded host has run hostbench).
+      Verdict: True, provisionally — the first free-threaded session should
+      confirm with ``tools/hostbench.py --scale small`` and update this
+      docstring with the number.
+
+    Correctness is not at stake either way: :func:`count_words_parallel` is
+    bit-identical to the serial counter at any worker count (tested), so this
+    helper only gates throughput.
+    """
+    if workers <= 1:
+        return False
     import sys
     try:
-        return sys._is_gil_enabled()  # free-threaded CPython 3.13+
+        return not sys._is_gil_enabled()  # free-threaded CPython 3.13+
     except AttributeError:
-        return True
+        return False  # stock CPython: GIL always on
 
 
 def read_corpus(path: str, lowercase: bool = False) -> Iterator[List[str]]:

@@ -207,6 +207,55 @@ def sgns_step_core(
     return EmbeddingPair(new_syn0, new_syn1), metrics
 
 
+def shared_pool_coeffs(
+    e_in: jax.Array,       # [B, D] compute_dtype
+    e_pos: jax.Array,      # [B, D] compute_dtype
+    Z: jax.Array,          # [P, D] compute_dtype
+    contexts: jax.Array,   # int32 [B]
+    negatives: jax.Array,  # int32 [P]
+    mask: jax.Array,       # float32 [B]
+    alpha: jax.Array,
+    num_negatives: int,
+    sigmoid_mode: str,
+    logits_dtype: jnp.dtype,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The shared-pool logit chain: (f_pos, f_neg, neg_valid, g_pos, g_neg).
+
+    Extracted so the GSPMD step (:func:`sgns_step_shared_core`) and the
+    explicit shard_map lowering (:mod:`.sgns_shard`) run op-for-op identical
+    coefficient math — the two lowerings must never drift in anything but
+    collective placement."""
+    P = negatives.shape[0]
+    f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)
+    f_neg = (e_in @ Z.T).astype(logits_dtype)           # [B, P] — MXU
+    neg_valid = (negatives[None, :] != contexts[:, None]).astype(logits_dtype) \
+        * mask[:, None].astype(logits_dtype)
+    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask
+    g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode))
+             * jnp.asarray(alpha, logits_dtype) * neg_valid
+             * jnp.asarray(num_negatives / P, logits_dtype))
+    return f_pos, f_neg, neg_valid, g_pos, g_neg
+
+
+def shared_pool_loss_terms(
+    f_pos: jax.Array,      # [B] float32
+    f_neg: jax.Array,      # [B, P] logits_dtype
+    neg_valid: jax.Array,  # [B, P] logits_dtype
+    mask: jax.Array,       # float32 [B]
+    num_negatives: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pre-division loss/mean_f_pos numerators (scalars). Shared by both
+    lowerings; the shard_map step psums these across data shards before
+    dividing by the global pair count, the single-program step divides
+    directly — same math either way."""
+    P = f_neg.shape[-1]
+    loss_num = (-_log_sigmoid(f_pos) * mask
+                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1,
+                          dtype=jnp.float32)
+                * (num_negatives / P)).sum()
+    return loss_num, (f_pos * mask).sum()
+
+
 def sgns_step_shared(
     params: EmbeddingPair,
     centers: jax.Array,    # int32 [B]
@@ -280,21 +329,14 @@ def sgns_step_shared_core(
     trainer's pair accounting). The trainer dispatches this variant for chunks
     no heartbeat will sample."""
     syn0, syn1 = params
-    P = negatives.shape[0]
     V = syn0.shape[0]
     e_in = syn0[centers].astype(compute_dtype)          # [B, D]
     e_pos = syn1[contexts].astype(compute_dtype)        # [B, D]
     Z = syn1[negatives].astype(compute_dtype)           # [P, D]
 
-    f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)
-    f_neg = (e_in @ Z.T).astype(logits_dtype)           # [B, P] — MXU
-    neg_valid = (negatives[None, :] != contexts[:, None]).astype(logits_dtype) \
-        * mask[:, None].astype(logits_dtype)
-
-    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask
-    g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode))
-             * jnp.asarray(alpha, logits_dtype) * neg_valid
-             * jnp.asarray(num_negatives / P, logits_dtype))
+    f_pos, f_neg, neg_valid, g_pos, g_neg = shared_pool_coeffs(
+        e_in, e_pos, Z, contexts, negatives, mask, alpha,
+        num_negatives, sigmoid_mode, logits_dtype)
 
     if duplicate_scaling:
         cnt0 = jnp.zeros(V, jnp.float32).at[centers].add(mask)
@@ -330,11 +372,10 @@ def sgns_step_shared_core(
 
     if with_metrics:
         denom = jnp.maximum(mask.sum(), 1.0)
-        loss = (-_log_sigmoid(f_pos) * mask
-                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1,
-                          dtype=jnp.float32)
-                * (num_negatives / P)).sum() / denom
-        mean_f_pos = (f_pos * mask).sum() / denom
+        loss_num, fpos_num = shared_pool_loss_terms(
+            f_pos, f_neg, neg_valid, mask, num_negatives)
+        loss = loss_num / denom
+        mean_f_pos = fpos_num / denom
     else:
         loss = mean_f_pos = jnp.float32(0.0)
     metrics = StepMetrics(

@@ -1,0 +1,229 @@
+"""Explicit shard_map lowering of the shared-pool SGNS step (docs/sharding.md).
+
+The GSPMD path (:func:`.sgns.sgns_step_shared_core` under jit +
+``with_sharding_constraint``) leaves the sharded step's collective schedule to
+the compiler pass (Xu et al., "GSPMD", 2021); its collective profile at the
+production geometry was never inspected — every multi-chip number in PERF.md §7
+was a formula estimate. This module is the hand-lowered replacement, the TPU
+analog of the reference's CIKM'16 discipline (Ordentlich et al.: ship indices
+and scalar coefficients, keep embedding-row traffic off the wire):
+
+Per step, on the (data, model) mesh with rows sharded over ``model``
+(each shard owns ``Vs = V/num_model`` contiguous rows) and the batch split
+over ``data`` (``Bl = B/num_data`` pairs per shard):
+
+1. **Forward assembly — ONE psum over the model axis.** Each model shard
+   gathers the rows it owns (``index − row_offset``, OOB rows masked to zero)
+   for this data shard's centers, contexts, and the shared pool, concatenated
+   into one ``[2·Bl + P, D]`` block; a single ``psum`` over ``model``
+   assembles the full rows (every row has exactly one owner, so the psum adds
+   exact zeros). This is the only model-axis collective in the step.
+2. **Local logit/coefficient chain.** f_pos/f_neg/g_pos/g_neg and the update
+   deltas d_in/d_pos/d_Z run per data shard on the assembled rows — op-for-op
+   the shared helpers of :mod:`.sgns`, so the two lowerings cannot drift.
+3. **Data-axis payload exchange — ONE all_gather over the data axis.** The
+   per-shard update payload (``[2·Bl + P, D]`` deltas, already cast to the
+   param dtype, plus the int32 index list) is all-gathered over ``data``:
+   bytes scale with the BATCH (2·Bl·D·b per shard), not with V/num_model —
+   the dense alternative (scatter into a [Vs, D] zero delta, psum_scatter by
+   row ownership, all_gather the applied sub-blocks back) moves
+   ~2·Vs·D·b and loses whenever V/num_model > ~2·B/num_data, which includes
+   every north-star geometry (V=1M B=64k: 98 MB vs 50 MB per shard at 2×4);
+   it is recorded here as considered-and-priced-out, not built.
+4. **Owner-local scatters only.** Every shard localizes the gathered index
+   list (``index − row_offset``; rows it does not own become an out-of-range
+   sentinel and are DROPPED by the scatter), then applies ONE scatter-add per
+   matrix. ZERO update bytes cross the model axis — vs the ~4·B·D·b
+   round-trip PERF.md §7 priced for the default lowering — and each shard's
+   applied update rows are only those targeting its ``Vs`` rows, so the
+   per-update-row scatter bound (PERF.md §2, ~27 ns/row) divides by
+   ``num_model`` (dropped candidates ride the §3-measured cheap regime:
+   at num_model ≥ 8 the drop fraction ≥ 87.5% is past the 81% knee).
+
+Metrics (when not elided) are per-shard scalars psum'd over ``data`` — three
+floats, not a collective that shows up in a bytes audit.
+
+The schedule is audited, not asserted: ``tools/collectives.py`` compiles both
+lowerings and tabulates every collective in the HLO with its mesh axis and
+bytes; ``tools/shard_ab.py`` A/Bs step time and numeric agreement across mesh
+shapes. Equivalence: f64 ~1e-12 against both the GSPMD lowering and the
+single-device step at every 8-device mesh shape (tests/test_shard_map_step.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from glint_word2vec_tpu.ops.sgns import (
+    EmbeddingPair, StepMetrics, shared_pool_coeffs, shared_pool_loss_terms)
+from glint_word2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def _owned_rows(mat: jax.Array, idx: jax.Array, row_offset: jax.Array) -> jax.Array:
+    """Gather ``mat[idx]`` restricted to this shard's rows: local index =
+    ``idx − row_offset``, out-of-range rows exactly zero (so the model-axis
+    psum of all shards' partials reconstructs each row bit-exactly — one
+    owner contributes the row, the rest contribute 0.0, and x + 0.0 == x)."""
+    vs = mat.shape[0]
+    loc = idx - row_offset
+    inb = (loc >= 0) & (loc < vs)
+    rows = mat[jnp.where(inb, loc, 0)]
+    return jnp.where(inb[..., None], rows, jnp.zeros((), mat.dtype))
+
+
+def _owner_local_scatter_add(
+    mat: jax.Array, idx: jax.Array, upd: jax.Array, row_offset: jax.Array,
+) -> jax.Array:
+    """``mat.at[idx].add(upd)`` applying ONLY rows this shard owns: non-owned
+    indices map to the out-of-range sentinel ``Vs`` and are dropped by the
+    scatter (mode="drop") — zero collective traffic, ~1/num_model of the
+    update rows actually applied per shard."""
+    vs = mat.shape[0]
+    loc = idx - row_offset
+    loc = jnp.where((loc >= 0) & (loc < vs), loc, vs)
+    return mat.at[loc].add(upd, mode="drop")
+
+
+def make_shard_map_sgns_step(
+    mesh: Mesh,
+    num_negatives: int,
+    sigmoid_mode: str = "exact",
+    compute_dtype: jnp.dtype = jnp.float32,
+    logits_dtype: jnp.dtype = jnp.float32,
+    with_metrics: bool = True,
+) -> Callable[..., Tuple[EmbeddingPair, StepMetrics]]:
+    """Build the explicitly-scheduled sharded step. The returned function has
+    the trainer's ``inner`` signature — ``(params, batch, negatives, alpha) ->
+    (EmbeddingPair, StepMetrics)`` on GLOBAL arrays — so
+    ``trainer._build_step`` swaps it in for :func:`.sgns.sgns_step_shared_core`
+    behind ``config.step_lowering`` with no other plumbing.
+
+    Requirements (validated at trace time with real messages): the padded
+    vocab divides ``num_model`` (pad_vocab_for_sharding guarantees it) and the
+    batch divides ``num_data``. ``duplicate_scaling`` has no shard_map form
+    (global in-batch occurrence counts would need a [V]-sized psum) — the
+    config selection matrix refuses the combination up front.
+    """
+    nd = mesh.shape[DATA_AXIS]
+    nm = mesh.shape[MODEL_AXIS]
+
+    def local_step(syn0, syn1, centers, contexts, mask, negatives, alpha):
+        # per-device blocks: syn0/syn1 [Vs, D]; centers/contexts/mask [Bl];
+        # negatives [P] and alpha replicated.
+        #
+        # SERIALIZATION PROPERTY (learned from a live rendezvous-starvation
+        # deadlock on the 8-device CPU mesh — trainer._sync_collectives has
+        # the full story): every collective in this program should data-
+        # depend on the params carry. The index all_gather and the elided
+        # twin's `pairs` psum otherwise depend only on the FEED, so a run
+        # dispatched behind another collective-bearing program could start
+        # those collectives early and race it on XLA:CPU's shared rendezvous
+        # pool. The barrier ties the batch inputs to syn0/syn1 so every
+        # collective waits for the carry; params are program inputs, so
+        # within-program TPU/GPU stream scheduling is untouched.
+        centers, contexts, mask, negatives, syn0, syn1 = (
+            jax.lax.optimization_barrier(
+                (centers, contexts, mask, negatives, syn0, syn1)))
+        vs = syn0.shape[0]
+        bl = centers.shape[0]
+        pool = negatives.shape[0]
+        row_offset = (jax.lax.axis_index(MODEL_AXIS) * vs).astype(jnp.int32)
+
+        # (1) forward assembly: owner-local gathers, ONE psum over `model`
+        cat = jnp.concatenate([
+            _owned_rows(syn0, centers, row_offset),
+            _owned_rows(syn1, contexts, row_offset),
+            _owned_rows(syn1, negatives, row_offset),
+        ], axis=0)                                   # [2·Bl + P, D] param dtype
+        if nm > 1:
+            cat = jax.lax.psum(cat, MODEL_AXIS)
+        e_in = cat[:bl].astype(compute_dtype)
+        e_pos = cat[bl:2 * bl].astype(compute_dtype)
+        Z = cat[2 * bl:].astype(compute_dtype)
+
+        # (2) the shared coefficient/update math — literally the same helpers
+        # the GSPMD step runs (ops/sgns.py), per data shard
+        f_pos, f_neg, neg_valid, g_pos, g_neg = shared_pool_coeffs(
+            e_in, e_pos, Z, contexts, negatives, mask, alpha,
+            num_negatives, sigmoid_mode, logits_dtype)
+        gn = g_neg.astype(compute_dtype)
+        d_in = g_pos[:, None].astype(compute_dtype) * e_pos + gn @ Z
+        d_pos = g_pos[:, None].astype(compute_dtype) * e_in
+        d_Z = gn.T @ e_in                            # [P, D] partial over Bl pairs
+
+        # (3) data-axis payload exchange: deltas in param dtype + int32 indices,
+        # ONE all_gather each (the index list is 4 bytes/row — noise next to
+        # the D·b-byte delta rows). nd == 1 skips the collective entirely.
+        dtype = syn0.dtype
+        payload = jnp.concatenate(
+            [d_in, d_pos, d_Z], axis=0).astype(dtype)  # [2·Bl + P, D]
+        idx = jnp.concatenate([centers, contexts, negatives])
+        if nd > 1:
+            payload = jax.lax.all_gather(payload, DATA_AXIS, tiled=True)
+            idx = jax.lax.all_gather(idx, DATA_AXIS, tiled=True)
+        # split back into per-matrix streams: every data shard's first Bl rows
+        # target syn0 (centers), the rest target syn1 (contexts + pool; the
+        # nd pool copies are partial d_Z sums — scatter-add accumulates them)
+        seg = payload.reshape(nd, 2 * bl + pool, -1)
+        seg_idx = idx.reshape(nd, 2 * bl + pool)
+        upd0 = seg[:, :bl].reshape(nd * bl, -1)
+        idx0 = seg_idx[:, :bl].reshape(-1)
+        upd1 = seg[:, bl:].reshape(nd * (bl + pool), -1)
+        idx1 = seg_idx[:, bl:].reshape(-1)
+
+        # (4) owner-local scatters — ZERO update bytes cross the model axis
+        new_syn0 = _owner_local_scatter_add(syn0, idx0, upd0, row_offset)
+        new_syn1 = _owner_local_scatter_add(syn1, idx1, upd1, row_offset)
+
+        # metrics: three scalars psum'd over `data` (loss/mean_f_pos follow
+        # the GSPMD step's masked-mean: global numerators / global pair count)
+        if with_metrics:
+            loss_num, fpos_num = shared_pool_loss_terms(
+                f_pos, f_neg, neg_valid, mask, num_negatives)
+            stats = jnp.stack([loss_num, fpos_num, mask.sum()])
+            if nd > 1:
+                stats = jax.lax.psum(stats, DATA_AXIS)
+            denom = jnp.maximum(stats[2], 1.0)
+            loss, mean_f_pos, pairs = stats[0] / denom, stats[1] / denom, stats[2]
+        else:
+            pairs = mask.sum()
+            if nd > 1:
+                pairs = jax.lax.psum(pairs, DATA_AXIS)
+            loss = mean_f_pos = jnp.float32(0.0)
+        return new_syn0, new_syn1, loss, mean_f_pos, pairs
+
+    mapped = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None),
+                  P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None), P(), P(), P()),
+        # outputs ARE replicated where the specs say so (every data replica
+        # applies the identical all-gathered payload to the identical block;
+        # scalars ride a psum) — but the tracer cannot prove it through the
+        # scatters, so replication checking is off
+        check_rep=False)
+
+    def step(params, batch, negatives, alpha):
+        syn0, syn1 = params
+        v, b = syn0.shape[0], batch["centers"].shape[0]
+        if v % nm:
+            raise ValueError(
+                f"shard_map step needs the padded vocab ({v}) divisible by "
+                f"num_model={nm} (pad_vocab_for_sharding guarantees this in "
+                "the trainer)")
+        if b % nd:
+            raise ValueError(
+                f"shard_map step needs the batch ({b}) divisible by "
+                f"num_data={nd}")
+        s0, s1, loss, mean_f_pos, pairs = mapped(
+            syn0, syn1, batch["centers"], batch["contexts"], batch["mask"],
+            negatives, alpha)
+        return EmbeddingPair(s0, s1), StepMetrics(
+            loss=loss, mean_f_pos=mean_f_pos, pairs=pairs)
+
+    return step

@@ -161,6 +161,40 @@ def pad_dim_to_lanes(vector_size: int, enabled: bool = True) -> int:
     return -(-vector_size // 128) * 128 if enabled else vector_size
 
 
+def classify_replica_groups(
+    num_data: int, num_model: int, groups: Sequence[Sequence[int]],
+) -> str:
+    """Which mesh axis a collective's replica groups span — the bridge between
+    compiled-HLO collectives and the (data, model) mesh for the collective
+    audit (tools/collectives.py).
+
+    Devices are laid out row-major ``arange(nd*nm).reshape(nd, nm)``
+    (:func:`make_mesh`), so a collective over:
+
+    - ``model``: groups are the mesh ROWS — ``{0..nm-1}, {nm..2nm-1}, ...``
+    - ``data``:  groups are the mesh COLUMNS — ``{0, nm, 2nm, ...}, ...``
+    - ``all``:   one group covering every device (either axis trivial, or a
+      collective over both axes)
+    - ``other``: anything else (a partitioner rewrite this audit must surface,
+      not silently bucket)
+
+    Groups are compared as SETS: XLA may order ids within a group arbitrarily.
+    """
+    n = num_data * num_model
+    got = sorted((frozenset(int(i) for i in g) for g in groups),
+                 key=lambda s: min(s) if s else -1)
+    grid = np.arange(n).reshape(num_data, num_model)
+    if got == [frozenset(range(n))]:
+        return "all"
+    rows = sorted(frozenset(int(i) for i in r) for r in grid)
+    if got == rows:
+        return "model"
+    cols = sorted(frozenset(int(i) for i in c) for c in grid.T)
+    if got == cols:
+        return "data"
+    return "other"
+
+
 def pad_vocab_for_sharding(vocab_size: int, num_model: int, multiple: int = 8) -> int:
     """Smallest padded row count divisible by num_model (and a lane-friendly multiple).
 

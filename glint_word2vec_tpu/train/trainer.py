@@ -303,6 +303,13 @@ class Trainer:
                 "embedding_partition='cols' is experimental and single-host only: "
                 "row-shards checkpoints and multi-process runs need each process "
                 "to own whole rows (design rationale: PERF.md §7); use 'rows'")
+        if (config.step_lowering == "shard_map"
+                and config.pairs_per_batch % plan.num_data):
+            raise ValueError(
+                f"step_lowering='shard_map' splits the batch over the data "
+                f"axis with static shapes: pairs_per_batch="
+                f"{config.pairs_per_batch} must be divisible by num_data="
+                f"{plan.num_data}")
         self.padded_vocab = pad_vocab_for_sharding(vocab.size, plan.num_model)
         # Pad the minor dim to the TPU lane width: D=300 rows are misaligned and row
         # gathers/scatters measurably slower than at 384. Padded columns are zero-init and
@@ -432,6 +439,23 @@ class Trainer:
         self._finite_fn: Optional[Callable] = None
         self._copy_params_fn: Optional[Callable] = None
         self._poison_fn: Optional[Callable] = None  # scripted NaN injection
+        # At most ONE collective-bearing program may be in flight on a
+        # multi-device CPU mesh: XLA:CPU collectives rendezvous across
+        # per-device threads of a bounded shared pool, so when a SECOND
+        # program reaches its collectives while the first is still at a
+        # rendezvous, the two runs' blocked participants can starve each
+        # other and everything stops at 0% CPU. Observed live on the forced
+        # 8-device mesh (either step lowering, ~200-dispatch fits): the
+        # racers were the producer-thread feed-touch program
+        # (_stage_to_device — its cross-shard reduction lowers to
+        # collectives; now skipped on this backend) and the finiteness probe
+        # (now dispatched only after draining the carry). This flag guards
+        # both and gates _after_dispatch, which drains the carry after every
+        # chunk so the invariant holds for the dispatch pipeline itself.
+        # TPU/GPU execute programs in launch order on the device stream — no
+        # gate, pipelining untouched.
+        self._sync_collectives = (
+            jax.default_backend() == "cpu" and plan.mesh.devices.size > 1)
         self._step_fn = self._build_step()
         # fast twin (metrics elided) for the shared-pool paths (skip-gram and
         # CBOW): the paths whose loss side-channel is an extra full [B, pool]
@@ -741,11 +765,25 @@ class Trainer:
             if not quiet:
                 self._stability_warnings()
 
-            def inner(params, batch, negatives, alpha):
-                return sgns_step_shared_core(
-                    params, batch["centers"], batch["contexts"], batch["mask"],
-                    negatives, alpha, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
-                    cfg.duplicate_scaling, logits_dtype, with_metrics)
+            if cfg.step_lowering == "shard_map":
+                # the explicit schedule (ops/sgns_shard.py, docs/sharding.md):
+                # owner-local gathers + ONE model-axis psum forward, owner-local
+                # scatters + ONE data-axis payload all_gather backward — zero
+                # update bytes over the model axis (HLO-audited,
+                # tools/collectives.py). The config selection matrix already
+                # refused cbow/pallas/duplicate_scaling/cols beside it.
+                from glint_word2vec_tpu.ops.sgns_shard import (
+                    make_shard_map_sgns_step)
+                inner = make_shard_map_sgns_step(
+                    plan.mesh, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
+                    logits_dtype, with_metrics)
+            else:
+                def inner(params, batch, negatives, alpha):
+                    return sgns_step_shared_core(
+                        params, batch["centers"], batch["contexts"],
+                        batch["mask"], negatives, alpha, cfg.negatives,
+                        cfg.sigmoid_mode, compute_dtype,
+                        cfg.duplicate_scaling, logits_dtype, with_metrics)
 
             neg_shape = shared_pool_shape
         elif cfg.cbow and cfg.negative_pool > 0 and not cfg.duplicate_scaling:
@@ -811,6 +849,9 @@ class Trainer:
                 K = alphas.shape[0]
                 negatives = sample_negatives_hash(
                     prob, alias, seed, base_step, neg_shape(K, Sd * Bl))
+                # tie feed + negatives to the params carry (see chunk below)
+                params, arrays, negatives = jax.lax.optimization_barrier(
+                    (params, arrays, negatives))
 
                 def body(p, inp):
                     xs, alpha, nv, negs = inp
@@ -856,6 +897,19 @@ class Trainer:
                 B = arrays["pairs"].shape[2]
             negatives = sample_negatives_hash(
                 prob, alias, seed, base_step, neg_shape(K, B))
+            # SERIALIZATION PROPERTY: every collective in the chunk should
+            # data-depend on the params carry, so a chunk dispatched behind
+            # another program can never start its collectives early. The feed
+            # arrays and the pre-scan sampler output are otherwise carry-
+            # independent (GSPMD is free to reshard them with small
+            # all-gathers), which would let chunk N+1's collectives race
+            # chunk N's on XLA:CPU's shared rendezvous pool — the starvation
+            # deadlock documented at _sync_collectives (whose gate is the
+            # enforced fix; this barrier removes the structural exposure at
+            # zero cost — params are program inputs, so within-program
+            # TPU/GPU scheduling is untouched).
+            params, arrays, negatives = jax.lax.optimization_barrier(
+                (params, arrays, negatives))
             pos = jnp.arange(B // S, dtype=jnp.float32)
 
             def body(p, inp):
@@ -920,6 +974,10 @@ class Trainer:
             K = alphas.shape[0]
             negatives = sample_negatives_hash(
                 prob, alias, seed, base_step, (K, cfg.negative_pool))
+            # tie feed + negatives to the params carry (see _build_step's
+            # chunk for the live-deadlock rationale)
+            params, arrays, negatives = jax.lax.optimization_barrier(
+                (params, arrays, negatives))
 
             def body(p, inp):
                 xs, alpha, nv, negs = inp
@@ -940,6 +998,17 @@ class Trainer:
             return jax.lax.scan(body, params, (arrays, alphas, nvalid, negatives))
 
         return jax.jit(banded_chunk, donate_argnums=(0,))
+
+    def _after_dispatch(self) -> None:
+        """Collective-program serialization gate (see __init__): on the
+        multi-device CPU backend, wait for the dispatched chunk's carry
+        before anything else may launch a program. No-op elsewhere, so the
+        host/device pipelining this trainer is built around is unchanged on
+        real accelerators; on the CPU mesh the dispatch_time split becomes
+        device-inclusive, which that backend never reported honestly
+        anyway."""
+        if self._sync_collectives:
+            jax.block_until_ready(self.params)
 
     def _dispatch_step_fn(self, max_steps: int) -> Callable:
         """The step function for the NEXT dispatch: the fast (metrics-elided)
@@ -1118,6 +1187,7 @@ class Trainer:
                     np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias)
                 self.dispatch_time += time.perf_counter() - t0
+                self._after_dispatch()
                 self._finish_round(
                     real, chunk["real_pairs"], chunk["meta"][0], metrics,
                     TrainState(iteration=chunk["iteration"],
@@ -1531,6 +1601,7 @@ class Trainer:
                     self._table_prob, self._table_alias,
                     self._keep_prob_dev, chunk["sub_bases"], chunk["win_bases"])
                 self.dispatch_time += time.perf_counter() - t0
+                self._after_dispatch()
                 pairs_arrays.append(metrics.pairs)
                 dropped_arrays.append(dropped)
                 est_total += chunk["est_pairs"]
@@ -1900,8 +1971,11 @@ class Trainer:
                     self._assert_feed_consistent(
                         dict(arrays, sub=sub_bases, win=win_bases), meta)
                 stacked = put_global(self._chunk_shardings, arrays)
-                if staged:
+                if staged and not self._sync_collectives:
                     # force the upload DMA now, overlapped with chunk compute
+                    # (skipped on the CPU mesh — see _stage_to_device; the
+                    # gate condition is identical on every process, so the
+                    # pinned cross-process launch order stays consistent)
                     self._touch(stacked)
                 if use[pid] and held is not None:
                     cur_sprog = np.asarray(held["sprog"], np.int64)
@@ -1949,6 +2023,7 @@ class Trainer:
                         self._keep_prob_dev, rnd["sub_bases"],
                         rnd["win_bases"])
                 self.dispatch_time += time.perf_counter() - t0
+                self._after_dispatch()
                 pairs_arrays.append(metrics.pairs)
                 dropped_arrays.append(dropped)
                 est_total += rnd["est_pairs"]
@@ -2005,8 +2080,15 @@ class Trainer:
             chunk["arrays"] = stacked
             # retain the forcing op's output with the chunk (never fetched — a
             # blocking fetch here stalls the producer behind the device queue,
-            # measured slower; the dispatch is enough to enqueue the upload)
-            chunk["_touch"] = self._touch(stacked)
+            # measured slower; the dispatch is enough to enqueue the upload).
+            # NOT on the multi-device CPU mesh: the touch's tiny cross-shard
+            # reduction lowers to collectives, and a producer-THREAD program
+            # racing the main thread's chunk is exactly the rendezvous-
+            # starvation deadlock __init__ documents (this touch was the
+            # racer observed live). There is no lazy-upload wire to force on
+            # that backend anyway — device_put is a host memcpy.
+            if not self._sync_collectives:
+                chunk["_touch"] = self._touch(stacked)
             yield chunk
 
     def _touch(self, stacked):
@@ -2069,6 +2151,15 @@ class Trainer:
         if self._finite_fn is None:
             self._finite_fn = jax.jit(
                 lambda p: jnp.isfinite(p.syn0).all() & jnp.isfinite(p.syn1).all())
+        # Drain in-flight chunk dispatches BEFORE launching the probe. On a
+        # multi-device mesh the probe's cross-shard reduction is itself a
+        # collective-bearing program; dispatching it while a chunk is still
+        # at its collective rendezvous puts two independent collective
+        # programs in flight — the XLA:CPU rendezvous-starvation deadlock
+        # documented at _sync_collectives in __init__. Waiting on the carry
+        # is the sync the heartbeat fetch was already paying, so
+        # steady-state cost is unchanged.
+        jax.block_until_ready(self.params)
         return bool(self._finite_fn(self.params))
 
     def _copy_params(self, params: EmbeddingPair) -> EmbeddingPair:
@@ -2440,6 +2531,7 @@ class Trainer:
                     np.int32(self.global_step + 1),
                     self._table_prob, self._table_alias)
                 self.dispatch_time += time.perf_counter() - t0
+                self._after_dispatch()
                 self._finish_round(
                     real, real_pairs, meta[0], metrics,
                     TrainState(

@@ -1,0 +1,202 @@
+"""Interleaved GSPMD-vs-shard_map A/B across mesh shapes (the scale-out step).
+
+For each (data, model) mesh shape over the forced 8-device CPU mesh —
+1x8, 2x4, 4x2, 8x1 — this builds TWO production Trainers that differ ONLY in
+``config.step_lowering`` ("gspmd" = compiler-scheduled collectives,
+"shard_map" = the explicit owner-local schedule of ops/sgns_shard.py), feeds
+both the identical packed-pair chunk, and reports:
+
+- step time per lowering (interleaved A/B medians, the PERF.md §3
+  methodology: variants alternate within one process so allocator drift and
+  co-tenant noise hit both alike; two-point-slope timing via
+  tools/microbench.py);
+- numeric agreement: max |Δ| between the two lowerings' params after one
+  identical chunk from identical initial params (they are NOT bit-identical —
+  different FP reduction orders — but must agree to f32 reassociation noise;
+  the f64 ~1e-12 equivalence lives in tests/test_shard_map_step.py).
+
+On this CPU mesh the TIME column is indicative only (CPU collective/scatter
+economics are nothing like ICI + the TPU scatter emitter); the collective-
+bytes evidence is tools/collectives.py, and the first hardware session should
+re-run this tool on a real pod slice — the harness is the deliverable. The
+agreement column is meaningful everywhere.
+
+Run:  python tools/shard_ab.py [--smoke] [--b 16384] [--v 100000] [--d 384]
+      [--pool 512] [--k 4] [--repeats 3]
+Prints a table on stderr and exactly ONE JSON line on stdout.
+``--smoke`` (tiny geometry, 1 repeat) is wired into tier-1
+(tests/test_shard_map_step.py) so the harness cannot rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# self-provision the virtual multi-device CPU mesh BEFORE jax initializes
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MESHES = [(1, 8), (2, 4), (4, 2), (8, 1)]
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_trainer(lowering: str, shape, vocab, args):
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    cfg = Word2VecConfig(
+        vector_size=args.d, min_count=1, pairs_per_batch=args.b,
+        negatives=5, negative_pool=args.pool, steps_per_dispatch=args.k,
+        window=5, seed=7, step_lowering=lowering)
+    return Trainer(cfg, vocab, plan=make_mesh(*shape))
+
+
+def ab_one_mesh(shape, vocab, args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from microbench import time_chunked
+
+    from glint_word2vec_tpu.ops.sgns import EmbeddingPair
+
+    K, B = args.k, args.b
+    rng = np.random.default_rng(42)
+    res = {"mesh": list(shape)}
+    trainers = {low: make_trainer(low, shape, vocab, args)
+                for low in ("gspmd", "shard_map")}
+    t0 = trainers["gspmd"]
+    # identical initial params on both (same seed/geometry -> same init);
+    # host copies survive donation so every timing run re-places fresh params
+    syn0_h = np.asarray(t0.params.syn0)
+    syn1_h = np.asarray(t0.params.syn1)
+    assert np.array_equal(syn0_h, np.asarray(trainers["shard_map"].params.syn0))
+
+    n_sets = 4
+    feeds = []
+    for i in range(n_sets):
+        r = np.random.default_rng(500 + i)
+        feeds.append(jax.device_put(
+            r.integers(0, vocab.size, (K, 2, B)).astype(t0._pair_dtype),
+            t0.plan.pairs_stacked))
+    meta = np.stack([np.full((K,), 0.025, np.float32),
+                     np.full((K,), B, np.float32)])
+
+    # numeric agreement: one identical chunk from identical params
+    outs = {}
+    for low, tr in trainers.items():
+        p = EmbeddingPair(jax.device_put(syn0_h, tr.plan.embedding),
+                          jax.device_put(syn1_h, tr.plan.embedding))
+        new_p, _ = tr._step_fn(p, {"pairs": feeds[0]}, meta, np.int32(1),
+                               tr._table_prob, tr._table_alias)
+        outs[low] = jax.tree.map(np.asarray, new_p)
+    diff = max(
+        float(np.max(np.abs(outs["gspmd"].syn0.astype(np.float64)
+                            - outs["shard_map"].syn0.astype(np.float64)))),
+        float(np.max(np.abs(outs["gspmd"].syn1.astype(np.float64)
+                            - outs["shard_map"].syn1.astype(np.float64)))))
+    res["max_abs_diff"] = diff
+    # scale reference so the smoke assertion is relative, not absolute
+    res["param_abs_max"] = float(np.max(np.abs(outs["gspmd"].syn0)))
+
+    times = {"gspmd": [], "shard_map": []}
+    for rep in range(args.repeats):
+        for low in ("gspmd", "shard_map"):      # interleaved
+            tr = trainers[low]
+
+            def run(p, feed, base, tr=tr):
+                return tr._step_fn(p, {"pairs": feed}, meta, base,
+                                   tr._table_prob, tr._table_alias)
+
+            make_carry = lambda tr=tr: EmbeddingPair(       # noqa: E731
+                jax.device_put(syn0_h, tr.plan.embedding),
+                jax.device_put(syn1_h, tr.plan.embedding))
+            args_for_iter = lambda i: (feeds[i % n_sets],   # noqa: E731
+                                       np.int32(100 + i))
+            fetch = lambda c, out: c.syn0[0, 0].astype(jnp.float32)  # noqa: E731
+            try:
+                spc = time_chunked(run, make_carry=make_carry,
+                                   args_for_iter=args_for_iter,
+                                   n_lo=2, n_hi=6, fetch=fetch)
+            except RuntimeError:
+                # loaded/noisy host: the two-point slope can go non-positive
+                # on sub-100ms chunks. Fall back to direct chained timing —
+                # honest on CPU (synchronous dispatch; no tunnel to lie
+                # through), which is the only backend this tool times anyway
+                import time as _time
+                c = make_carry()
+                c, out = run(c, *args_for_iter(0))          # warm
+                float(fetch(c, out))
+                t0 = _time.perf_counter()
+                n = 4
+                for i in range(n):
+                    c, out = run(c, *args_for_iter(i))
+                float(fetch(c, out))
+                spc = (_time.perf_counter() - t0) / n
+            times[low].append(spc / K * 1e3)
+    for low in ("gspmd", "shard_map"):
+        res[f"{low}_ms"] = float(np.median(times[low]))
+    res["speedup_shard_map"] = res["gspmd_ms"] / res["shard_map_ms"]
+    log(f"mesh {shape[0]}x{shape[1]}: gspmd {res['gspmd_ms']:8.2f} ms/step  "
+        f"shard_map {res['shard_map_ms']:8.2f} ms/step  "
+        f"(x{res['speedup_shard_map']:.2f})  max|dparam| {diff:.2e}")
+    return res
+
+
+def run(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny geometry, 1 repeat (the tier-1 wiring)")
+    ap.add_argument("--b", type=int, default=16384)
+    ap.add_argument("--v", type=int, default=100_000)
+    ap.add_argument("--d", type=int, default=384)
+    ap.add_argument("--pool", type=int, default=512)
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.b, args.v, args.d, args.pool = 1024, 8192, 64, 128
+        args.k, args.repeats = 2, 1
+
+    import jax
+    if len(jax.devices()) < 8:
+        raise SystemExit(
+            f"need 8 devices (have {len(jax.devices())}); run as a script so "
+            "the CPU mesh self-provisions")
+    log(f"device: {jax.devices()[0]}  B={args.b} V={args.v} D={args.d} "
+        f"pool={args.pool} K={args.k} repeats={args.repeats}")
+
+    from glint_word2vec_tpu.data.vocab import Vocabulary
+    counts = np.maximum(1e9 / (np.arange(args.v) + 10.0) ** 1.07, 5.0)
+    vocab = Vocabulary.from_words_and_counts(
+        [f"w{i}" for i in range(args.v)], counts.astype(np.int64))
+
+    result = {
+        "geometry": {"b": args.b, "v": args.v, "d": args.d,
+                     "pool": args.pool, "k": args.k},
+        "backend": jax.devices()[0].platform,
+        "meshes": [ab_one_mesh(shape, vocab, args) for shape in MESHES],
+    }
+    return result
+
+
+def main(argv=None) -> None:
+    print(json.dumps(run(argv)))
+
+
+if __name__ == "__main__":
+    main()
