@@ -466,6 +466,24 @@ class Word2VecConfig:
                     "cbow_update='banded' with window=1 emits no contexts at "
                     "all under the reference's legacy asymmetric window "
                     "(b = nextInt(1) = 0 always) — use window >= 2")
+        # --- pallas selection matrix (graftlint R8 refusal-matrix parity:
+        # trainer._build_step carries the dispatch-side twin of these two
+        # refusals; every combination refused there must be refused at
+        # construction too, so no checkpoint can ever store knobs the
+        # dispatch will later reject). Multi-device×pallas stays dispatch-
+        # only — it depends on the mesh plan, which config cannot see.
+        if self.use_pallas:
+            if self.cbow:
+                raise ValueError(
+                    "use_pallas=True is not implemented for CBOW — the fused "
+                    "kernel is SGNS-only; use the XLA CBOW paths "
+                    "(cbow_update='scatter'/'banded')")
+            if self.duplicate_scaling:
+                raise ValueError(
+                    "duplicate_scaling is not implemented for use_pallas=True "
+                    "— the fused kernel applies sum semantics only; use the "
+                    "XLA path or bound the row loads via "
+                    "negative_pool/subsample_ratio instead")
         if (self.cbow and self.duplicate_scaling and self.negative_pool > 0):
             raise ValueError(
                 "CBOW with duplicate_scaling=True implements mean semantics "

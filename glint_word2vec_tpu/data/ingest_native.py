@@ -97,10 +97,14 @@ def count_words_native(corpus_path: str, n_threads: int):
             logger.warning("native ingest count failed on %r; falling back "
                            "to the Python pass", corpus_path)
             return None
-        with open(wpath, "rb") as f:
+        # idempotent reads of the native pass's finished outputs — safe to
+        # retry, unlike the encode passes themselves (graftlint R5)
+        with retry_io(lambda: open(wpath, "rb"),
+                      what=f"native ingest words {wpath!r}") as f:
             raw = f.read()
         words = raw.decode("utf-8", errors="replace").split("\n")[:-1]
-        counts = np.fromfile(cpath, dtype=np.int64)
+        counts = retry_io(lambda: np.fromfile(cpath, dtype=np.int64),
+                          what=f"native ingest counts {cpath!r}")
     if len(words) != n or counts.shape[0] != n:
         logger.warning("native ingest count output inconsistent "
                        "(%d words / %d counts / %d reported); falling back",

@@ -196,7 +196,12 @@ def parallel_counting_profitable(workers: int = 2) -> bool:
 def read_corpus(path: str, lowercase: bool = False) -> Iterator[List[str]]:
     """Whitespace-tokenized line-per-sentence reader (the format of the reference's toy
     corpus, which ships pre-tokenized and lowercased; it spec:22-37)."""
-    with open(path, "r", encoding="utf-8") as f:
+    from glint_word2vec_tpu.train.faults import retry_io
+
+    # only the open retries (graftlint R5): the line iteration is one-shot —
+    # re-reading a partially consumed stream would silently duplicate lines
+    with retry_io(lambda: open(path, "r", encoding="utf-8"),
+                  what=f"open corpus {path!r}") as f:
         for line in f:
             toks = line.split()
             if not toks:

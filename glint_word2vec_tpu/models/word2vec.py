@@ -170,7 +170,8 @@ class Word2VecModel:
                 idx = jnp.asarray(flat, jnp.int32)
                 seg_ids = jnp.asarray(seg, jnp.int32)
                 sums = jax.ops.segment_sum(
-                    self.syn0[idx], seg_ids, num_segments=len(rows_in_batch))
+                    self.syn0[idx].astype(jnp.float32), seg_ids,
+                    num_segments=len(rows_in_batch))
                 counts = jax.ops.segment_sum(
                     jnp.ones(len(flat), jnp.float32), seg_ids,
                     num_segments=len(rows_in_batch))

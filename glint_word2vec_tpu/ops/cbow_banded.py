@@ -78,6 +78,7 @@ def cumsum_rows(x: jax.Array) -> jax.Array:
     tri = jnp.tril(jnp.ones((chunk, chunk), x.dtype))  # [i, j] = 1 iff j <= i
     within = jnp.einsum("ij,rjd->rid", tri, xp)        # inclusive within-chunk
     totals = within[:, -1, :]                          # [rows, D]
+    # graftlint: disable=R4 -- accumulation dtype is the CALLER's contract (docstring above); both call sites pass >=f32 and are R4-checked there
     offs = jnp.cumsum(totals, axis=0) - totals         # exclusive chunk offsets
     return (within + offs[:, None, :]).reshape(rows * chunk, D)[:T]
 

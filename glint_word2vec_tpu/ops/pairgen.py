@@ -26,7 +26,7 @@ reported by the trainer), if fewer the tail slots are masked. The host packer ta
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -130,14 +130,14 @@ def build_alias_table(counts: np.ndarray, power: float = 0.75,
             return _alias_pair_sweep(
                 scaled, prob, alias, idx[sc < 1.0], idx[sc >= 1.0])
 
-        if workers > 1:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(
-                    max_workers=min(workers, P),
-                    thread_name_prefix="glint-alias") as pool:
-                leftovers = list(pool.map(sweep_partition, range(P)))
-        else:
-            leftovers = [sweep_partition(c) for c in range(P)]
+        # R1 determinism audit (ISSUE 5): this fan-out is ordered-merge safe —
+        # partitions mutate disjoint strided index sets and the leftovers are
+        # consumed in partition order below — so it routes through the one
+        # blessed pool primitive instead of an ad-hoc executor. workers<=1
+        # degrades to the same serial loop as before inside ordered_pool_map.
+        from glint_word2vec_tpu.data.pipeline import ordered_pool_map
+        leftovers = list(ordered_pool_map(
+            sweep_partition, range(P), workers=min(workers, P)))
         small = np.concatenate([s for s, _ in leftovers])
         large = np.concatenate([l for _, l in leftovers])
     else:

@@ -32,7 +32,6 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from glint_word2vec_tpu.ops.sampler import AliasTable, sample_negatives
 
@@ -156,7 +155,6 @@ def sgns_step_core(
     trainer jits (sampling happens once per dispatch chunk, outside the scan, because
     in-program threefry is catastrophically slow on TPU; see ops/prng.py)."""
     syn0, syn1 = params
-    B = centers.shape[0]
     V = syn0.shape[0]
     neg_valid = (negatives != contexts[:, None]).astype(jnp.float32) * mask[:, None]
 

@@ -832,7 +832,6 @@ class Trainer:
             W = cfg.window
             Sd = self.plan.num_data
             Bl = cfg.pairs_per_batch // Sd
-            T = self._tokens_per_step
 
             gen = jax.vmap(
                 lambda tk, st, nv, lo, hi, kp, sb, wb: device_block_pairs(
@@ -959,7 +958,6 @@ class Trainer:
         from glint_word2vec_tpu.ops.pairgen import device_cbow_windows
         W = cfg.window
         H = self._block_halo
-        Sd = self.plan.num_data
         emb_sharding = self._emb_sharding
 
         win = jax.vmap(
@@ -998,6 +996,24 @@ class Trainer:
             return jax.lax.scan(body, params, (arrays, alphas, nvalid, negatives))
 
         return jax.jit(banded_chunk, donate_argnums=(0,))
+
+    def _stage_dispatch_meta(self, meta: np.ndarray, base_step, *bases):
+        """Explicitly stage the small per-dispatch host arrays (the meta rows,
+        the PRNG base step, and any hash-lattice base vectors) as replicated
+        device arrays. The compiled-step transfer contract (tools/stepaudit.py,
+        docs/static-analysis.md; enforced by a scripted fit under
+        ``jax.transfer_guard("disallow")``) requires every jitted-chunk
+        argument to arrive on device: an implicit numpy→device transfer at
+        dispatch time is exactly the silent host-transfer regression the
+        auditor exists to catch. Cost: a few hundred replicated bytes per
+        dispatch through the same put_global discipline as the feed arrays."""
+        host = {"meta": np.asarray(meta, np.float32),
+                "base": np.int32(base_step)}
+        for i, b in enumerate(bases):
+            host[f"b{i}"] = b
+        placed = put_global(self.plan.replicated, host)
+        return (placed["meta"], placed["base"],
+                *[placed[f"b{i}"] for i in range(len(bases))])
 
     def _after_dispatch(self) -> None:
         """Collective-program serialization gate (see __init__): on the
@@ -1182,9 +1198,10 @@ class Trainer:
                 stacked = (chunk["arrays"] if staged else
                            put_global(self._chunk_shardings, chunk["arrays"]))
                 real = chunk["real"]
+                meta_dev, base_dev = self._stage_dispatch_meta(
+                    chunk["meta"], self.global_step + 1)
                 self.params, metrics = self._dispatch_step_fn(real)(
-                    self.params, stacked, chunk["meta"],
-                    np.int32(self.global_step + 1),
+                    self.params, stacked, meta_dev, base_dev,
                     self._table_prob, self._table_alias)
                 self.dispatch_time += time.perf_counter() - t0
                 self._after_dispatch()
@@ -1595,11 +1612,14 @@ class Trainer:
                 stacked = (chunk["arrays"] if staged else
                            put_global(self._chunk_shardings, chunk["arrays"]))
                 real = chunk["real"]
+                meta_dev, base_dev, sub_dev, win_dev = \
+                    self._stage_dispatch_meta(
+                        chunk["meta"], self.global_step + 1,
+                        chunk["sub_bases"], chunk["win_bases"])
                 self.params, (metrics, dropped) = self._dispatch_step_fn(real)(
-                    self.params, stacked, chunk["meta"],
-                    np.int32(self.global_step + 1),
+                    self.params, stacked, meta_dev, base_dev,
                     self._table_prob, self._table_alias,
-                    self._keep_prob_dev, chunk["sub_bases"], chunk["win_bases"])
+                    self._keep_prob_dev, sub_dev, win_dev)
                 self.dispatch_time += time.perf_counter() - t0
                 self._after_dispatch()
                 pairs_arrays.append(metrics.pairs)
@@ -2015,13 +2035,15 @@ class Trainer:
                 if rnd is None:
                     break
                 t0 = time.perf_counter()
+                meta_dev, base_dev, sub_dev, win_dev = \
+                    self._stage_dispatch_meta(
+                        rnd["meta"], self.global_step + 1,
+                        rnd["sub_bases"], rnd["win_bases"])
                 self.params, (metrics, dropped) = \
                     self._dispatch_step_fn(rnd["real"])(
-                        self.params, rnd["stacked"], rnd["meta"],
-                        np.int32(self.global_step + 1),
+                        self.params, rnd["stacked"], meta_dev, base_dev,
                         self._table_prob, self._table_alias,
-                        self._keep_prob_dev, rnd["sub_bases"],
-                        rnd["win_bases"])
+                        self._keep_prob_dev, sub_dev, win_dev)
                 self.dispatch_time += time.perf_counter() - t0
                 self._after_dispatch()
                 pairs_arrays.append(metrics.pairs)
@@ -2526,9 +2548,10 @@ class Trainer:
                 if cfg.feed_consistency_check:
                     self._assert_feed_consistent(feed, meta)
                 stacked = put_global(self._chunk_shardings, feed)
+                meta_dev, base_dev = self._stage_dispatch_meta(
+                    meta, self.global_step + 1)
                 self.params, metrics = self._dispatch_step_fn(real)(
-                    self.params, stacked, meta,
-                    np.int32(self.global_step + 1),
+                    self.params, stacked, meta_dev, base_dev,
                     self._table_prob, self._table_alias)
                 self.dispatch_time += time.perf_counter() - t0
                 self._after_dispatch()

@@ -1,0 +1,7 @@
+"""R1 bad: ad-hoc thread pool in library code (unordered merge)."""
+from concurrent.futures import ThreadPoolExecutor
+
+
+def parallel_lengths(jobs, workers):
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(len, jobs))

@@ -1,0 +1,6 @@
+"""R1 good: the fan-out routes through the blessed ordered-merge primitive."""
+from glint_word2vec_tpu.data.pipeline import ordered_pool_map
+
+
+def parallel_lengths(jobs, workers):
+    return list(ordered_pool_map(len, jobs, workers=workers))

@@ -1,0 +1,8 @@
+"""R2 bad: stdlib random + unseeded module-level numpy RNG."""
+import random
+
+import numpy as np
+
+
+def draw(n):
+    return [random.random() for _ in range(n)], np.random.rand(n)

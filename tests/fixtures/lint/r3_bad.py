@@ -1,0 +1,15 @@
+"""R3 bad: host-sync ops inside a jit-wrapped function."""
+import time
+
+import jax
+import numpy as np
+
+
+def step(params, batch):
+    scale = float(batch.mean())          # concretizes a tracer
+    t0 = time.perf_counter()             # trace-time constant
+    host = np.asarray(params)            # device->host copy
+    return params * scale + host.sum() + t0
+
+
+step_fn = jax.jit(step)

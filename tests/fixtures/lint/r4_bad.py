@@ -1,0 +1,8 @@
+"""R4 bad: prefix accumulation with no static dtype evidence — fed bf16
+params this cancels the interval it computes."""
+import jax.numpy as jnp
+
+
+def context_sums(rows):
+    prefix = jnp.cumsum(rows, axis=0)
+    return prefix[4:] - prefix[:-4]

@@ -1,0 +1,9 @@
+"""R4 good: the accumulation dtype is pinned ≥f32 in the def-use chain."""
+import jax.numpy as jnp
+
+
+def context_sums(rows):
+    pf = jnp.promote_types(rows.dtype, jnp.float32)
+    wide = rows.astype(pf)
+    prefix = jnp.cumsum(wide, axis=0)
+    return prefix[4:] - prefix[:-4]

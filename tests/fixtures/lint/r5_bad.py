@@ -1,0 +1,9 @@
+"""R5 bad: bare data-plane reads (open + memmap) outside retry_io."""
+import numpy as np
+
+
+def load(path):
+    with open(path, "r", encoding="utf-8") as f:
+        meta = f.read()
+    tokens = np.memmap(path + ".bin", dtype=np.int32, mode="r")
+    return meta, tokens

@@ -1,0 +1,22 @@
+"""R5 good: reads retry with backoff; the write pass is exempt by design
+(one-shot encode must restart from scratch, never blind-retry)."""
+import numpy as np
+
+from glint_word2vec_tpu.train.faults import retry_io
+
+
+def load(path):
+    def _open():
+        return open(path, "r", encoding="utf-8")
+
+    with retry_io(_open, what="open meta") as f:
+        meta = f.read()
+    tokens = retry_io(
+        lambda: np.memmap(path + ".bin", dtype=np.int32, mode="r"),
+        what="mmap tokens")
+    return meta, tokens
+
+
+def write(path, text):
+    with open(path, "w", encoding="utf-8") as f:  # write: exempt
+        f.write(text)

@@ -1,0 +1,9 @@
+"""R6 bad: raw device placement in the trainer outside the staging
+discipline."""
+import jax
+
+
+class Trainer:
+    def _fit(self, arrays):
+        staged = {k: jax.device_put(v) for k, v in arrays.items()}
+        return staged

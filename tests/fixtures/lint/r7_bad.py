@@ -1,0 +1,9 @@
+"""R7 bad: human chatter on stdout + a second JSON line."""
+import json
+
+
+def main():
+    print("starting benchmark")
+    result = {"ok": True}
+    print(json.dumps(result))
+    print(json.dumps({"extra": 1}))

@@ -1,0 +1,12 @@
+"""R7 good: humans read stderr; stdout carries exactly one JSON line."""
+import json
+import sys
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    log("starting benchmark")
+    print(json.dumps({"ok": True}))

@@ -1,0 +1,19 @@
+"""R8 bad config half: no construction-time refusal for the combinations the
+trainer fixture refuses at dispatch. The single-knob negative_pool RANGE
+check must NOT count as coverage for the {cbow, negative_pool} dispatch
+combo — its condition says nothing about the combination."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class Word2VecConfig:
+    cbow: bool = False
+    use_pallas: bool = False
+    negative_pool: int = -1
+    vector_size: int = 100
+
+    def __post_init__(self) -> None:
+        if self.vector_size <= 0:
+            raise ValueError("vector_size must be positive")
+        if self.negative_pool < -1:
+            raise ValueError("negative_pool must be >= -1")

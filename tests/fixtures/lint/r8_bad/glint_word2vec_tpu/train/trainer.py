@@ -1,0 +1,15 @@
+"""R8 bad trainer half: two dispatch-only refusals — one with no config twin
+at all (cbow x use_pallas), one 'covered' only by a single-knob range check
+(cbow x negative_pool), which is not coverage."""
+
+
+class Trainer:
+    def _build_step(self):
+        cfg = self.config
+        if cfg.use_pallas:
+            if cfg.cbow:
+                raise ValueError("use_pallas is SGNS-only")
+        if cfg.cbow:
+            if cfg.negative_pool == 0:
+                raise ValueError("cbow needs the shared pool here")
+        return None

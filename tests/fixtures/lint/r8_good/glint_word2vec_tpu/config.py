@@ -1,0 +1,23 @@
+"""R8 good config half: every dispatch refusal has a multi-knob
+construction-time twin (range checks ride alongside, as in the real
+config)."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class Word2VecConfig:
+    cbow: bool = False
+    use_pallas: bool = False
+    negative_pool: int = -1
+    vector_size: int = 100
+
+    def __post_init__(self) -> None:
+        if self.vector_size <= 0:
+            raise ValueError("vector_size must be positive")
+        if self.negative_pool < -1:
+            raise ValueError("negative_pool must be >= -1")
+        if self.use_pallas:
+            if self.cbow:
+                raise ValueError("use_pallas is SGNS-only")
+        if self.cbow and self.negative_pool == 0:
+            raise ValueError("cbow needs the shared pool here")

@@ -1,0 +1,13 @@
+"""R8 good trainer half: same dispatch guards; config carries both twins."""
+
+
+class Trainer:
+    def _build_step(self):
+        cfg = self.config
+        if cfg.use_pallas:
+            if cfg.cbow:
+                raise ValueError("use_pallas is SGNS-only")
+        if cfg.cbow:
+            if cfg.negative_pool == 0:
+                raise ValueError("cbow needs the shared pool here")
+        return None

@@ -12,7 +12,6 @@ Float64 runs (via jax.experimental.enable_x64) hold the two formulations to
 interval endpoint is a hard failure, not noise.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

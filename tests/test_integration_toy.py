@@ -17,7 +17,6 @@ import numpy as np
 import pytest
 
 from glint_word2vec_tpu import (
-    ServerSideGlintWord2Vec,
     ServerSideGlintWord2VecModel,
     Word2Vec,
 )

@@ -2,8 +2,6 @@
 thread-count independence. The stream contract lives in data/hashrng.py; the C++ side
 must reproduce it exactly or silently corrupt training — hence bit-level assertions."""
 
-import os
-
 import numpy as np
 import pytest
 

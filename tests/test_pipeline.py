@@ -61,12 +61,10 @@ def test_subsample_extremes():
 def test_dynamic_window_legacy_asymmetric():
     # Reference (mllib:384-388): context = [max(0,i-b), min(i+b, len)) \ {i} — the upper
     # bound is exclusive, so right context has b-1 words. Verify against brute force.
-    rng_draws = np.random.default_rng(42)
     L, window = 23, 5
     sent = np.arange(100, 100 + L, dtype=np.int32)
 
     # reproduce internal rng: same seed → same b draws
-    rng = np.random.default_rng(7)
     b = np.random.default_rng(7).integers(0, window, size=L)
     centers, contexts = dynamic_window_pairs(sent, window, np.random.default_rng(7))
 

@@ -1,0 +1,21 @@
+"""graftlint — repo-invariant static analysis for glint-word2vec-tpu.
+
+Layer 1 of the two-layer static-analysis subsystem (docs/static-analysis.md;
+layer 2 is the compiled-artifact contract auditor, tools/stepaudit.py). The
+engine walks the library/tool sources, runs the repo-specific rules R1–R8
+(tools/graftlint/rules.py — each encodes an invariant a prior PR paid to
+learn), honors per-line suppressions with written justifications, and exits
+nonzero on any unsuppressed finding. Wired into tier-1 via
+tests/test_graftlint.py and into CI as its own job.
+
+Run:  python -m tools.graftlint [--json] [--json-out F] [--baseline F]
+"""
+
+from tools.graftlint.engine import (  # noqa: F401  (public surface)
+    Finding,
+    LintReport,
+    lint_repo,
+    lint_text,
+    suppressed_inventory,
+)
+from tools.graftlint.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,12 @@
+"""``python -m tools.graftlint`` entry point."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.graftlint.engine import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

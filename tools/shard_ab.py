@@ -75,7 +75,6 @@ def ab_one_mesh(shape, vocab, args) -> dict:
     from glint_word2vec_tpu.ops.sgns import EmbeddingPair
 
     K, B = args.k, args.b
-    rng = np.random.default_rng(42)
     res = {"mesh": list(shape)}
     trainers = {low: make_trainer(low, shape, vocab, args)
                 for low in ("gspmd", "shard_map")}
