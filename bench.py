@@ -653,5 +653,27 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def run_smoke() -> None:
+    """``--smoke``: the fast gate only — the interleaved telemetry-off/on
+    trainer A/B (tools/telemetry_run.measure_overhead, 3 trials each arm at
+    heartbeat cadence). The acceptance bar for the observability layer is
+    telemetry_overhead_frac < 0.02; the full bench rows are untouched (run
+    without flags for BENCH_r* artifacts). One JSON line on stdout (R7)."""
+    import jax
+    dev = jax.devices()[0]
+    log(f"device: {dev} ({dev.platform}) — smoke mode (telemetry overhead A/B)")
+    import telemetry_run
+    res = telemetry_run.measure_overhead(600)
+    print(json.dumps({
+        "metric": "telemetry_overhead_frac",
+        "value": res["telemetry_overhead_frac"],
+        "acceptance": "< 0.02 at heartbeat cadence (docs/observability.md)",
+        **res,
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv[1:]:
+        run_smoke()
+    else:
+        main()

@@ -364,6 +364,62 @@ class Word2VecConfig:
                                     # keeps diverging needs a config change
                                     # (lr/pool/subsample), not infinite retries
 
+    # --- run telemetry (docs/observability.md; no reference analog — its only
+    # observability is the every-10k-words driver log line, mllib:411-412) ---
+    telemetry_path: str = ""        # non-empty: write the schema-versioned JSONL
+                                    # run log here (obs/sink.py — rotating file,
+                                    # NEVER stdout: the driver tools' one-JSON-
+                                    # line contract, graftlint R7, must survive a
+                                    # telemetry-on trainer inside any of them).
+                                    # Carries run_start/run_end, extended
+                                    # heartbeats (norm channels, per-phase host
+                                    # timings), and watchdog records; the host
+                                    # trace spans export beside it as
+                                    # <telemetry_path>.trace.json (Chrome trace
+                                    # format — Perfetto-loadable). Empty
+                                    # (default) = telemetry off, zero cost
+    telemetry_rotate_bytes: int = 64 << 20  # rotate the run log past this size
+                                    # (<path>.1..<path>.3 kept) so long-run
+                                    # telemetry is disk-bounded
+    heartbeat_ring: int = 512       # in-memory Trainer.heartbeats capacity (a
+                                    # bounded ring — pre-round-11 this list grew
+                                    # one record per heartbeat forever, ~weeks-
+                                    # long runs leaked). The full history
+                                    # persists in the telemetry sink file
+    norm_watch: str = "off"         # finite-blowup watchdog over the fused
+                                    # health probe's row-norm channels
+                                    # (obs/probe.py, heartbeat cadence — the
+                                    # guardrail for the measured 1.6M-vocab
+                                    # FINITE collapse where nonfinite_policy
+                                    # never fires, EVAL.md round-5 / ROADMAP 2).
+                                    # "off" (default): probe channels still
+                                    # recorded when telemetry is on, nothing
+                                    # fires. "warn": log + telemetry record per
+                                    # firing probe, training continues. "halt":
+                                    # raise NormBlowupError (fail-fast, the
+                                    # nonfinite_policy="halt" contract)
+    norm_watch_threshold: float = 100.0  # row-L2-norm boundary of the
+                                    # frac_over channel. Provenance: the EVAL
+                                    # harness's blown-row heuristic (rows with
+                                    # |emb| > 100, tools/eval_quality.py) —
+                                    # healthy trained rows sit at norm ~1-15
+                                    # across every EVAL_RUNS config; collapsed
+                                    # 1.6M-vocab rows measured orders of
+                                    # magnitude past 100 (docs/observability.md)
+    norm_watch_frac: float = 0.01   # watchdog fires when this fraction of a
+                                    # matrix's rows exceed the threshold — the
+                                    # collapse shows in a hot-row subset first
+    norm_watch_max: float = 1000.0  # hard ceiling on any single row norm —
+                                    # catches a lone runaway row the fraction
+                                    # channel dilutes at large vocabularies
+    profile_steps: int = 0          # with profile_dir set: stop the jax.profiler
+                                    # trace once this many steps complete after
+                                    # fit() starts (0 = trace the whole fit, the
+                                    # pre-round-11 behavior). A bounded window
+                                    # keeps pod traces loadable — whole-fit
+                                    # traces at production step counts are
+                                    # multi-GB
+
     def __post_init__(self) -> None:
         if self.embedding_partition not in ("rows", "cols"):
             raise ValueError(
@@ -592,6 +648,30 @@ class Word2VecConfig:
         if self.max_rollbacks < 0:
             raise ValueError(
                 f"max_rollbacks must be nonnegative but got {self.max_rollbacks}")
+        if self.norm_watch not in ("off", "warn", "halt"):
+            raise ValueError(
+                f"norm_watch must be 'off', 'warn', or 'halt' "
+                f"but got {self.norm_watch!r}")
+        if self.norm_watch_threshold <= 0:
+            raise ValueError(
+                f"norm_watch_threshold must be positive "
+                f"but got {self.norm_watch_threshold}")
+        if self.norm_watch_max <= 0:
+            raise ValueError(
+                f"norm_watch_max must be positive but got {self.norm_watch_max}")
+        if not (0 < self.norm_watch_frac <= 1):
+            raise ValueError(
+                f"norm_watch_frac must be in (0, 1] but got {self.norm_watch_frac}")
+        if self.heartbeat_ring <= 0:
+            raise ValueError(
+                f"heartbeat_ring must be positive but got {self.heartbeat_ring}")
+        if self.telemetry_rotate_bytes <= 0:
+            raise ValueError(
+                f"telemetry_rotate_bytes must be positive "
+                f"but got {self.telemetry_rotate_bytes}")
+        if self.profile_steps < 0:
+            raise ValueError(
+                f"profile_steps must be nonnegative but got {self.profile_steps}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
         if (getattr(self, "_auto_pool", False) and "negative_pool" not in kwargs

@@ -1,0 +1,26 @@
+"""Run-telemetry subsystem (docs/observability.md).
+
+Four layers, each usable alone, all off by default and zero-cost when off:
+
+- :mod:`.probe` — the fused on-device health reduction over the params carry
+  (finiteness + per-matrix row-norm channels), the instrumentation ROADMAP
+  item 2 names as the first step against the measured finite norm blowup.
+- :mod:`.watch` — the finite-blowup watchdog (``config.norm_watch``) that
+  fires on the probe channels where the non-finite guardrail stays silent.
+- :mod:`.sink` + :mod:`.schema` — the schema-versioned JSONL run log
+  (rotating file, never stdout — graftlint R7).
+- :mod:`.spans` — thread-safe host trace spans exported as Chrome-trace JSON
+  (Perfetto-loadable).
+"""
+
+from glint_word2vec_tpu.obs.probe import HealthStats, make_health_probe
+from glint_word2vec_tpu.obs.schema import SCHEMA_VERSION, validate_file, validate_record
+from glint_word2vec_tpu.obs.sink import TelemetrySink
+from glint_word2vec_tpu.obs.spans import Tracer, default_tracer
+from glint_word2vec_tpu.obs.watch import NormWatchdog
+
+__all__ = [
+    "HealthStats", "make_health_probe",
+    "SCHEMA_VERSION", "validate_file", "validate_record",
+    "TelemetrySink", "Tracer", "default_tracer", "NormWatchdog",
+]

@@ -1,0 +1,143 @@
+"""The telemetry JSONL schema: one versioned catalogue + the validator.
+
+Every record the sink writes carries ``schema`` (this module's
+:data:`SCHEMA_VERSION`), ``kind`` (one of :data:`KINDS`), and ``t`` (unix
+seconds). The validator is the drift gate: tests and CI validate every
+emitted file against the catalogue here, so a field rename/removal fails the
+build instead of silently orphaning downstream consumers of old run logs.
+Additive fields are fine (consumers must ignore unknown keys); renaming or
+removing a required field — or changing a type — requires a version bump and
+a catalogue entry, reviewed like any contract change.
+
+Run as a CLI (the CI schema-validation step)::
+
+    python -m glint_word2vec_tpu.obs.schema run.jsonl [more.jsonl ...]
+
+Prints one JSON summary line on stdout; exit code 0 iff every record of
+every file validates.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+SCHEMA_VERSION = 1
+
+# null is legal wherever a number is: the sink writes non-finite measured
+# values (NaN loss in a diverging run) as null to keep every line strict
+# RFC-8259 JSON (obs/sink.py _sanitize)
+_NUM = (int, float, type(None))
+
+# kind -> {field: allowed python types}. These are the REQUIRED fields; extra
+# keys are always allowed (additive evolution).
+KINDS: Dict[str, Dict[str, tuple]] = {
+    "run_start": {
+        "run_id": (str,),
+        "vocab_size": (int,),
+        "mesh": (list,),
+        "config": (dict,),       # the stability-relevant knob subset
+    },
+    "heartbeat": {
+        "step": (int,),
+        "words": (int,),
+        "alpha": _NUM,
+        "loss": _NUM,
+        "mean_f_pos": _NUM,
+        "pairs_per_sec": _NUM,
+        "host_wait_s": _NUM,     # host-side wait since the previous heartbeat
+        "dispatch_s": _NUM,      # dispatch time since the previous heartbeat
+        # optional: "norms" (the probe channel dict) when the probe ran
+    },
+    "watchdog": {
+        "step": (int,),
+        "policy": (str,),        # "warn" | "halt"
+        "reason": (str,),
+        "channels": (dict,),     # the probe channels the decision was made on
+    },
+    "run_end": {
+        "run_id": (str,),
+        "status": (str,),        # "ok" | "error"
+        "steps": (int,),
+        "pairs_trained": _NUM,
+        "host_wait_s_total": _NUM,
+        "dispatch_s_total": _NUM,
+        "watchdog_fires": (int,),
+    },
+}
+
+_COMMON = {"schema": (int,), "kind": (str,), "t": _NUM}
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Errors for one parsed record; empty list = valid."""
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    errs: List[str] = []
+    for field, types in _COMMON.items():
+        if field not in rec:
+            errs.append(f"missing common field {field!r}")
+        elif not isinstance(rec[field], types) or isinstance(rec[field], bool):
+            errs.append(f"{field!r} has type {type(rec[field]).__name__}")
+    if errs:
+        return errs
+    if rec["schema"] != SCHEMA_VERSION:
+        return [f"schema version {rec['schema']} != {SCHEMA_VERSION} "
+                f"(drift: bump the catalogue, not just the writer)"]
+    kind = rec["kind"]
+    if kind not in KINDS:
+        return [f"unknown kind {kind!r}"]
+    for field, types in KINDS[kind].items():
+        if field not in rec:
+            errs.append(f"{kind}: missing field {field!r}")
+        elif not isinstance(rec[field], types) or (
+                isinstance(rec[field], bool) and bool not in types):
+            errs.append(f"{kind}.{field} has type {type(rec[field]).__name__}, "
+                        f"expected {'/'.join(t.__name__ for t in types)}")
+    return errs
+
+
+def validate_file(path: str, max_errors: int = 20) -> Dict[str, Any]:
+    """Validate every line of a telemetry JSONL file (rotated segments are
+    just more files — pass each). Returns a summary dict with per-kind counts
+    and the first ``max_errors`` error strings."""
+    counts: Dict[str, int] = {}
+    errors: List[str] = []
+    n = 0
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n += 1
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path}:{lineno}: not JSON ({e})")
+                continue
+            errs = validate_record(rec)
+            if errs:
+                errors.extend(f"{path}:{lineno}: {e}" for e in errs)
+            else:
+                counts[rec["kind"]] = counts.get(rec["kind"], 0) + 1
+    return {"path": path, "records": n, "kinds": counts,
+            "ok": not errors, "errors": errors[:max_errors]}
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print(json.dumps({"ok": False,
+                          "errors": ["usage: python -m "
+                                     "glint_word2vec_tpu.obs.schema "
+                                     "FILE.jsonl [...]"]}))
+        return 2
+    results = [validate_file(p) for p in argv]
+    ok = all(r["ok"] for r in results) and all(
+        r["records"] > 0 for r in results)
+    print(json.dumps({"ok": ok, "schema": SCHEMA_VERSION, "files": results}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,176 @@
+"""Host trace spans: a small thread-safe span API + Chrome-trace export.
+
+The trainer already records two aggregate timers (``host_wait_time`` /
+``dispatch_time``), but PR 3/PR 4 added a multi-threaded producer, a
+one-round-ahead stager, and parallel checkpoint I/O — and no artifact shows
+where wall-clock actually goes across those threads, which is exactly what
+the first pod session (ROADMAP items 3–4) needs to attribute step time. A
+span is one timed region on one thread; the export is the Chrome trace event
+format (``chrome://tracing`` / Perfetto / ``about:tracing`` all load it), so
+nesting and cross-thread overlap render without any custom viewer.
+
+Design constraints:
+
+- zero-cost when disabled: ``span()`` returns a shared no-op context manager
+  (no allocation, no clock read) — every fit path can instrument
+  unconditionally;
+- thread-safe and bounded: events land in a ring (oldest dropped past
+  ``max_events``) under one lock held only for the append — producer/stager
+  threads never serialize against each other's timed regions;
+- no ad-hoc threads (graftlint R1): this module only OBSERVES threads.
+
+One process-wide default tracer exists so layers with no Trainer handle
+(checkpoint save/load) can record spans; the Trainer enables/clears it per
+run when telemetry is on.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tracer._record(self.name, self._t0, t1 - self._t0, self.args)
+        return None
+
+
+class Tracer:
+    """Collects complete ("X") spans; exports the Chrome trace event format."""
+
+    def __init__(self, enabled: bool = False, max_events: int = 200_000):
+        from collections import deque
+        self.enabled = enabled
+        self.max_events = int(max_events)
+        self._lock = threading.Lock()
+        # deque(maxlen): appending past capacity drops the OLDEST in O(1) —
+        # the tail of a long run is what a hang/slowdown investigation needs
+        self._events: "deque" = deque(maxlen=self.max_events)
+        self._dropped = 0
+        self._epoch = time.perf_counter()
+
+    def configure(self, enabled: bool) -> None:
+        self.enabled = enabled
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+            self._epoch = time.perf_counter()
+
+    def span(self, name: str, **args):
+        """Context manager timing one region on the calling thread."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, name, args or None)
+
+    def wrap_iter(self, name: str, it):
+        """Wrap an iterator so each ``next()`` is a span ON THE CONSUMING
+        THREAD — handed to a producer-thread iterator (``_threaded_iter``),
+        this times production where it happens. Always wraps: ``span()``
+        re-checks ``enabled`` per item (feed iterators are built before the
+        run bookkeeping arms the tracer), and the per-chunk no-op cost is
+        nothing next to chunk assembly."""
+
+        def gen():
+            src = iter(it)
+            while True:
+                with self.span(name):
+                    try:
+                        item = next(src)
+                    except StopIteration:
+                        return
+                yield item
+
+        return gen()
+
+    def _record(self, name: str, t0: float, dur: float,
+                args: Optional[dict]) -> None:
+        ev = (name, threading.get_ident(), threading.current_thread().name,
+              t0 - self._epoch, dur, args)
+        with self._lock:
+            if len(self._events) == self.max_events:
+                self._dropped += 1
+            self._events.append(ev)
+
+    # -- introspection / export -------------------------------------------------
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return [{"name": n, "tid": tid, "thread": tname,
+                 "ts_s": ts, "dur_s": dur, **({"args": a} if a else {})}
+                for n, tid, tname, ts, dur, a in evs]
+
+    def span_summary(self) -> Dict[str, dict]:
+        """Per-span-name {count, total_s, max_s} — the run_end digest."""
+        out: Dict[str, dict] = {}
+        for ev in self.events():
+            s = out.setdefault(ev["name"],
+                               {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            s["count"] += 1
+            s["total_s"] = round(s["total_s"] + ev["dur_s"], 6)
+            s["max_s"] = round(max(s["max_s"], ev["dur_s"]), 6)
+        return out
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the collected spans as a Chrome-trace JSON file; returns the
+        event count. Thread ids are remapped to small ints in first-seen
+        order, with metadata events naming each thread."""
+        with self._lock:
+            evs = list(self._events)
+            dropped = self._dropped
+        tid_map: Dict[int, int] = {}
+        names: Dict[int, str] = {}
+        trace = []
+        for n, tid, tname, ts, dur, a in evs:
+            small = tid_map.setdefault(tid, len(tid_map))
+            names.setdefault(small, tname)
+            ev = {"ph": "X", "name": n, "pid": 0, "tid": small,
+                  "ts": round(ts * 1e6, 1), "dur": round(dur * 1e6, 1)}
+            if a:
+                ev["args"] = a
+            trace.append(ev)
+        meta = [{"ph": "M", "name": "thread_name", "pid": 0, "tid": small,
+                 "args": {"name": tname}} for small, tname in names.items()]
+        doc = {"traceEvents": meta + trace, "displayTimeUnit": "ms",
+               "otherData": {"dropped_events": dropped}}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        return len(trace)
+
+
+_default = Tracer()
+
+
+def default_tracer() -> Tracer:
+    """The process-wide tracer (disabled until a telemetry-on run enables it)."""
+    return _default

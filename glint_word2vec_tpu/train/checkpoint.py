@@ -56,6 +56,23 @@ import numpy as np
 from glint_word2vec_tpu.config import Word2VecConfig
 from glint_word2vec_tpu.train import faults
 
+
+def _traced(name: str):
+    """Record this function as a host trace span on the process-wide tracer
+    (obs/spans.py) — a no-op until a telemetry-on run enables it. Imported
+    lazily at CALL time: this module sits on the train package's import path
+    and obs pulls train.faults back in."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            from glint_word2vec_tpu.obs.spans import default_tracer
+            with default_tracer().span(name):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco
+
 logger = logging.getLogger("glint_word2vec_tpu")
 
 # Per-layout format stamps: the dense .npy layout is unchanged since round 1 and stays
@@ -195,6 +212,7 @@ class TrainState:
                       if k in d})
 
 
+@_traced("checkpoint_save")
 def save_model(
     path: str,
     words: List[str],
@@ -306,6 +324,7 @@ def _write_array_shards(dirpath: str, arr, workers: int = 1) -> Dict[str, str]:
                     _run_io([t for _, t in jobs], workers)))
 
 
+@_traced("checkpoint_save_sharded")
 def save_model_sharded(
     path: str,
     words: List[str],
@@ -490,6 +509,7 @@ class ShardedMatrixReader:
         return self.read(0, self.rows, workers=workers)
 
 
+@_traced("checkpoint_load_plan")
 def load_params_into_plan(path: str, plan, padded_vocab: int, padded_dim: int,
                           dtype=np.float32, verify: bool = False,
                           io_workers: Optional[int] = None):
@@ -725,6 +745,7 @@ def load_model_header(path: str) -> Dict[str, Any]:
     }
 
 
+@_traced("checkpoint_load")
 def load_model(path: str, header: Optional[Dict[str, Any]] = None,
                verify: bool = True,
                io_workers: Optional[int] = None) -> Dict[str, Any]:

@@ -29,6 +29,12 @@ tests; all off by default and zero-cost when off):
 - ``GLINT_FAULT_NAN_AT_STEP=N`` — the trainer poisons one param entry with NaN
   at the first round whose global step reaches N (once), exercising the
   non-finite guardrail's halt/rollback policies.
+- ``GLINT_FAULT_SCALE_PARAMS_AT_STEP=N`` (with optional
+  ``GLINT_FAULT_SCALE_PARAMS_FACTOR``, default 1e6) — the trainer multiplies
+  the whole params carry once at the first round reaching step N: a FINITE
+  norm blowup, the measured large-vocab collapse signature the non-finite
+  guardrail cannot see — exercising the norm watchdog
+  (``config.norm_watch``, obs/watch.py).
 
 SIGKILL (not ``sys.exit``) is deliberate: no ``finally`` blocks, no atexit, no
 flushes — the same failure surface as an OOM-kill or preemption.
@@ -58,6 +64,12 @@ class NonFiniteParamsError(RuntimeError):
     when ``rollback`` has no snapshot left / exhausted its retry budget)."""
 
 
+class NormBlowupError(RuntimeError):
+    """Raised by the norm watchdog (``config.norm_watch="halt"``,
+    obs/watch.py) on a FINITE norm blowup — the measured large-vocab collapse
+    channel the non-finite guardrail cannot see (EVAL.md round-5 ladder)."""
+
+
 @dataclasses.dataclass
 class FaultPlan:
     """One scripted fault schedule. All zeros/empties = no faults."""
@@ -67,6 +79,12 @@ class FaultPlan:
     corrupt_checkpoint_bytes: int = 0
     fail_ingest_first_n: int = 0
     nan_at_step: int = 0
+    scale_params_at_step: int = 0  # multiply the params carry by
+                                   # scale_params_factor (once) — a FINITE
+                                   # blowup: the norm watchdog's channel, a
+                                   # state the nan_at_step injection cannot
+                                   # produce (isfinite stays True throughout)
+    scale_params_factor: float = 1e6
 
 
 _override: Optional[FaultPlan] = None
@@ -98,6 +116,15 @@ def _env_int(name: str) -> int:
         return 0
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    try:
+        return float(v) if v else default
+    except ValueError:
+        logger.warning("ignoring non-float %s=%r", name, v)
+        return default
+
+
 def active_plan() -> FaultPlan:
     """The effective plan: the in-process override if set, else the env (read
     fresh each call — fault consults sit on cold paths, and tests flip env
@@ -110,6 +137,9 @@ def active_plan() -> FaultPlan:
         corrupt_checkpoint_bytes=_env_int("GLINT_FAULT_CORRUPT_CKPT_BYTES"),
         fail_ingest_first_n=_env_int("GLINT_FAULT_FAIL_INGEST_FIRST_N"),
         nan_at_step=_env_int("GLINT_FAULT_NAN_AT_STEP"),
+        scale_params_at_step=_env_int("GLINT_FAULT_SCALE_PARAMS_AT_STEP"),
+        scale_params_factor=_env_float(
+            "GLINT_FAULT_SCALE_PARAMS_FACTOR", 1e6),
     )
 
 
@@ -164,6 +194,25 @@ def take_nan_injection(global_step: int) -> bool:
     logger.warning("injecting NaN into params at global step %d (scripted "
                    "nan_at_step=%d)", global_step, p.nan_at_step)
     return True
+
+
+def take_scale_injection(global_step: int) -> float:
+    """Trainer hook: the scripted scale factor exactly once, at the first
+    round whose global step reaches ``scale_params_at_step``; 0.0 otherwise.
+    The deterministic FINITE-blowup twin of :func:`take_nan_injection` —
+    scaled params stay finite, so the non-finite guardrail must stay silent
+    while the norm watchdog (obs/watch.py) fires."""
+    p = active_plan()
+    if not p.scale_params_at_step or global_step < p.scale_params_at_step:
+        return 0.0
+    if _counters.get("scale_done"):
+        return 0.0
+    _counters["scale_done"] = True
+    logger.warning(
+        "injecting finite param blowup (x%g) at global step %d (scripted "
+        "scale_params_at_step=%d)", p.scale_params_factor, global_step,
+        p.scale_params_at_step)
+    return float(p.scale_params_factor)
 
 
 def maybe_fail_ingest(what: str) -> None:

@@ -81,6 +81,17 @@ class HeartbeatRecord:
     loss: float
     mean_f_pos: float
     pairs_per_sec: float
+    # --- extended telemetry (round 11, docs/observability.md). Defaults keep
+    # pre-round-11 constructors valid; every field lands in the JSONL sink ---
+    global_step: int = -1
+    host_wait_s: float = 0.0       # host-side wait since the previous heartbeat
+    dispatch_s: float = 0.0        # dispatch time since the previous heartbeat
+    norms: Optional[dict] = None   # fused health-probe channels (obs/probe.py)
+                                   # when the probe ran this round: per-matrix
+                                   # max/mean/p99 row norm + frac_over, plus
+                                   # update_mag (delta of mean_norm between
+                                   # consecutive probes — a cheap update-
+                                   # magnitude proxy needing no extra pass)
 
 
 class _threaded_iter:
@@ -429,16 +440,43 @@ class Trainer:
         # off — restarting at 0 would redraw the run's opening negative-sample stream
         self.global_step = self.state.global_step
         self.pairs_trained = 0.0  # real (unmasked) pairs dispatched over this run
-        self.heartbeats: List[HeartbeatRecord] = []
+        from collections import deque
+        # bounded ring (config.heartbeat_ring): pre-round-11 this was an
+        # unbounded list — weeks-long runs leaked one record per heartbeat.
+        # The full history persists in the telemetry sink file instead.
+        self.heartbeats: "deque" = deque(maxlen=config.heartbeat_ring)
         # non-finite guardrail state (config.nonfinite_policy): a ring of the
         # last K good device-resident param snapshots plus small jitted probes,
         # all built lazily — a policy="none" run pays nothing
-        from collections import deque
         self._snapshot_ring: "deque" = deque(maxlen=config.rollback_history)
         self.rollbacks_performed = 0
-        self._finite_fn: Optional[Callable] = None
+        self._health_fn: Optional[Callable] = None  # fused probe (obs/probe.py)
         self._copy_params_fn: Optional[Callable] = None
         self._poison_fn: Optional[Callable] = None  # scripted NaN injection
+        self._scale_fn: Optional[Callable] = None   # scripted finite blowup
+        # run-telemetry layer (docs/observability.md) — all lazy/no-op when
+        # config.telemetry_path is empty and norm_watch is "off"
+        from glint_word2vec_tpu.obs.spans import default_tracer
+        from glint_word2vec_tpu.obs.watch import NormWatchdog
+        self._tracer = default_tracer()
+        self._telemetry = None
+        if config.telemetry_path:
+            from glint_word2vec_tpu.obs.sink import TelemetrySink
+            self._telemetry = TelemetrySink(
+                config.telemetry_path,
+                rotate_bytes=config.telemetry_rotate_bytes)
+        # arm (or DISARM) the process-wide tracer for this trainer — at
+        # construction, not only at fit start: the fit paths build their feed
+        # iterators before _start_run_bookkeeping runs, and the producer
+        # spans must observe the right state from the start. Disarming
+        # matters as much as arming: a telemetry-off trainer after a
+        # telemetry-on one in the same process (the overhead A/B's off arm)
+        # must not keep recording spans into the shared ring.
+        self._tracer.configure(enabled=self._telemetry is not None)
+        self.norm_watchdog = NormWatchdog(
+            config.norm_watch, config.norm_watch_threshold,
+            config.norm_watch_max, config.norm_watch_frac)
+        self._last_probe_channels: Optional[dict] = None
         # At most ONE collective-bearing program may be in flight on a
         # multi-device CPU mesh: XLA:CPU collectives rendezvous across
         # per-device threads of a bounded shared pool, so when a SECOND
@@ -1173,13 +1211,16 @@ class Trainer:
         # _stage_to_device), and with prefetch off the put stays in the consumer so
         # the host-wait/dispatch split keeps its documented meaning.
         staged = cfg.prefetch_chunks > 0 and jax.process_count() == 1
+        # span-wrap the producer so each chunk's assembly is timed ON the
+        # thread that runs it (the _threaded_iter producer when prefetching)
+        stream = self._tracer.wrap_iter("producer", chunk_stream())
         if staged:
             chunks = _threaded_iter(
-                self._stage_to_device(chunk_stream()), cfg.prefetch_chunks)
+                self._stage_to_device(stream), cfg.prefetch_chunks)
         elif cfg.prefetch_chunks > 0:
-            chunks = _threaded_iter(chunk_stream(), cfg.prefetch_chunks)
+            chunks = _threaded_iter(stream, cfg.prefetch_chunks)
         else:
-            chunks = chunk_stream()
+            chunks = stream
 
         self._start_run_bookkeeping()
         chunks = iter(chunks)
@@ -1195,14 +1236,16 @@ class Trainer:
                     # the replicated feed is the path where divergence CAN
                     # happen: every process regenerated the stream itself
                     self._assert_feed_consistent(chunk["arrays"], chunk["meta"])
-                stacked = (chunk["arrays"] if staged else
-                           put_global(self._chunk_shardings, chunk["arrays"]))
-                real = chunk["real"]
-                meta_dev, base_dev = self._stage_dispatch_meta(
-                    chunk["meta"], self.global_step + 1)
-                self.params, metrics = self._dispatch_step_fn(real)(
-                    self.params, stacked, meta_dev, base_dev,
-                    self._table_prob, self._table_alias)
+                with self._tracer.span("dispatch"):
+                    stacked = (chunk["arrays"] if staged else
+                               put_global(self._chunk_shardings,
+                                          chunk["arrays"]))
+                    real = chunk["real"]
+                    meta_dev, base_dev = self._stage_dispatch_meta(
+                        chunk["meta"], self.global_step + 1)
+                    self.params, metrics = self._dispatch_step_fn(real)(
+                        self.params, stacked, meta_dev, base_dev,
+                        self._table_prob, self._table_alias)
                 self.dispatch_time += time.perf_counter() - t0
                 self._after_dispatch()
                 self._finish_round(
@@ -1211,6 +1254,9 @@ class Trainer:
                                words_processed=chunk["words_processed"],
                                batches_done=chunk["batches_done"]),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
+        except BaseException:
+            self._abort_run()  # its docstring has the why-not-sys.exc_info
+            raise
         finally:
             self._stop_profiler()
             closer = getattr(chunks, "close", None)
@@ -1223,6 +1269,7 @@ class Trainer:
             finished=True, global_step=self.global_step)
         if checkpoint_path:
             self.save_checkpoint(checkpoint_path)
+        self._end_run("ok")
         return self.params
 
     def _device_seg_blocks(self, sentences: Sequence[np.ndarray], k: int, s: int,
@@ -1590,11 +1637,12 @@ class Trainer:
         staged = cfg.prefetch_chunks > 0  # this method is the single-process path
                                           # (multi-process device feed goes through
                                           # _fit_device_feed_sharded)
+        stream = self._tracer.wrap_iter("producer", chunk_stream())
         if staged:
             chunks = _threaded_iter(
-                self._stage_to_device(chunk_stream()), cfg.prefetch_chunks)
+                self._stage_to_device(stream), cfg.prefetch_chunks)
         else:
-            chunks = chunk_stream()
+            chunks = stream
 
         self._start_run_bookkeeping()
         chunks = iter(chunks)
@@ -1609,17 +1657,20 @@ class Trainer:
                 if chunk is None:
                     break
                 t0 = time.perf_counter()
-                stacked = (chunk["arrays"] if staged else
-                           put_global(self._chunk_shardings, chunk["arrays"]))
-                real = chunk["real"]
-                meta_dev, base_dev, sub_dev, win_dev = \
-                    self._stage_dispatch_meta(
-                        chunk["meta"], self.global_step + 1,
-                        chunk["sub_bases"], chunk["win_bases"])
-                self.params, (metrics, dropped) = self._dispatch_step_fn(real)(
-                    self.params, stacked, meta_dev, base_dev,
-                    self._table_prob, self._table_alias,
-                    self._keep_prob_dev, sub_dev, win_dev)
+                with self._tracer.span("dispatch"):
+                    stacked = (chunk["arrays"] if staged else
+                               put_global(self._chunk_shardings,
+                                          chunk["arrays"]))
+                    real = chunk["real"]
+                    meta_dev, base_dev, sub_dev, win_dev = \
+                        self._stage_dispatch_meta(
+                            chunk["meta"], self.global_step + 1,
+                            chunk["sub_bases"], chunk["win_bases"])
+                    self.params, (metrics, dropped) = \
+                        self._dispatch_step_fn(real)(
+                            self.params, stacked, meta_dev, base_dev,
+                            self._table_prob, self._table_alias,
+                            self._keep_prob_dev, sub_dev, win_dev)
                 self.dispatch_time += time.perf_counter() - t0
                 self._after_dispatch()
                 pairs_arrays.append(metrics.pairs)
@@ -1637,6 +1688,9 @@ class Trainer:
                                                for a, b in chunk["sprog"]],
                                shard_feed="tokens"),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
+        except BaseException:
+            self._abort_run()  # its docstring has the why-not-sys.exc_info
+            raise
         finally:
             self._stop_profiler()
             closer = getattr(chunks, "close", None)
@@ -1650,6 +1704,7 @@ class Trainer:
             finished=True, global_step=self.global_step)
         if checkpoint_path:
             self.save_checkpoint(checkpoint_path)
+        self._end_run("ok")
         return self.params
 
     def _settle_device_pairgen_books(
@@ -1863,10 +1918,11 @@ class Trainer:
                 if pending:
                     yield flush()
 
+        lstream = self._tracer.wrap_iter("producer", local_stream())
         if cfg.prefetch_chunks > 0:
-            chunks = _threaded_iter(local_stream(), cfg.prefetch_chunks)
+            chunks = _threaded_iter(lstream, cfg.prefetch_chunks)
         else:
-            chunks = iter(local_stream())
+            chunks = iter(lstream)
 
         # stage one round ahead (config.sharded_prefetch): the round generator
         # below runs on a _one_ahead_iter thread and launches the NEXT round's
@@ -1935,7 +1991,8 @@ class Trainer:
             pending = start_gather()
             while True:
                 t0 = time.perf_counter()
-                g = allgather_fetch(pending)  # leading [S] process axis
+                with self._tracer.span("allgather_fetch"):
+                    g = allgather_fetch(pending)  # leading [S] process axis
                 alive = g["alive"][:, 0] > 0                        # [S]
                 if not alive.any():
                     # every process observes the same all-dead round and stops
@@ -1990,13 +2047,15 @@ class Trainer:
                 if cfg.feed_consistency_check:
                     self._assert_feed_consistent(
                         dict(arrays, sub=sub_bases, win=win_bases), meta)
-                stacked = put_global(self._chunk_shardings, arrays)
-                if staged and not self._sync_collectives:
-                    # force the upload DMA now, overlapped with chunk compute
-                    # (skipped on the CPU mesh — see _stage_to_device; the
-                    # gate condition is identical on every process, so the
-                    # pinned cross-process launch order stays consistent)
-                    self._touch(stacked)
+                with self._tracer.span("stage_put"):
+                    stacked = put_global(self._chunk_shardings, arrays)
+                    if staged and not self._sync_collectives:
+                        # force the upload DMA now, overlapped with chunk
+                        # compute (skipped on the CPU mesh — see
+                        # _stage_to_device; the gate condition is identical on
+                        # every process, so the pinned cross-process launch
+                        # order stays consistent)
+                        self._touch(stacked)
                 if use[pid] and held is not None:
                     cur_sprog = np.asarray(held["sprog"], np.int64)
                     held = None
@@ -2035,15 +2094,16 @@ class Trainer:
                 if rnd is None:
                     break
                 t0 = time.perf_counter()
-                meta_dev, base_dev, sub_dev, win_dev = \
-                    self._stage_dispatch_meta(
-                        rnd["meta"], self.global_step + 1,
-                        rnd["sub_bases"], rnd["win_bases"])
-                self.params, (metrics, dropped) = \
-                    self._dispatch_step_fn(rnd["real"])(
-                        self.params, rnd["stacked"], meta_dev, base_dev,
-                        self._table_prob, self._table_alias,
-                        self._keep_prob_dev, sub_dev, win_dev)
+                with self._tracer.span("dispatch"):
+                    meta_dev, base_dev, sub_dev, win_dev = \
+                        self._stage_dispatch_meta(
+                            rnd["meta"], self.global_step + 1,
+                            rnd["sub_bases"], rnd["win_bases"])
+                    self.params, (metrics, dropped) = \
+                        self._dispatch_step_fn(rnd["real"])(
+                            self.params, rnd["stacked"], meta_dev, base_dev,
+                            self._table_prob, self._table_alias,
+                            self._keep_prob_dev, sub_dev, win_dev)
                 self.dispatch_time += time.perf_counter() - t0
                 self._after_dispatch()
                 pairs_arrays.append(metrics.pairs)
@@ -2063,6 +2123,9 @@ class Trainer:
                     # round fully consumed (dispatch + any heartbeat fetch /
                     # checkpoint collectives launched) — release the stager
                     rounds.ack()
+        except BaseException:
+            self._abort_run()  # its docstring has the why-not-sys.exc_info
+            raise
         finally:
             self._stop_profiler()
             closer = getattr(rounds, "close", None)
@@ -2079,6 +2142,7 @@ class Trainer:
             finished=True, global_step=self.global_step)
         if checkpoint_path:
             self.save_checkpoint(checkpoint_path)
+        self._end_run("ok")
         return self.params
 
     def _stage_to_device(self, chunks):
@@ -2098,7 +2162,8 @@ class Trainer:
         which pins one deterministic launch order; the remaining multi-process
         feeds keep the consumer-thread put."""
         for chunk in chunks:
-            stacked = put_global(self._chunk_shardings, chunk["arrays"])
+            with self._tracer.span("stage_put"):
+                stacked = put_global(self._chunk_shardings, chunk["arrays"])
             chunk["arrays"] = stacked
             # retain the forcing op's output with the chunk (never fetched — a
             # blocking fetch here stalls the producer behind the device queue,
@@ -2147,12 +2212,36 @@ class Trainer:
         self._last_log_time = time.perf_counter()
         self._last_log_step = self.global_step
         self._pairs_since_log = 0.0
+        self._last_hb_host_wait = 0.0
+        self._last_hb_dispatch = 0.0
         self._profiling = False
+        self._profile_start_step = self.global_step
         if self.config.profile_dir:
             import jax.profiler
             jax.profiler.start_trace(self.config.profile_dir)
             self._profiling = True
             logger.info("jax.profiler trace -> %s", self.config.profile_dir)
+        # run telemetry (docs/observability.md): stamp the run, arm the span
+        # tracer. The tracer is process-wide (checkpoint save/load record
+        # spans without a Trainer handle), cleared per run so a trace file
+        # describes exactly one fit.
+        import os
+        self._run_ended = False
+        self._run_id = f"{os.getpid()}-{int(time.time())}-{self.global_step}"
+        self._tracer.configure(enabled=self._telemetry is not None)
+        if self._telemetry is not None:
+            self._tracer.clear()
+            cfg = self.config
+            self._telemetry.emit(
+                "run_start", run_id=self._run_id, vocab_size=self.vocab.size,
+                mesh=[self.plan.num_data, self.plan.num_model],
+                config={k: getattr(cfg, k) for k in (
+                    "vector_size", "learning_rate", "pairs_per_batch",
+                    "negatives", "negative_pool", "subsample_ratio",
+                    "param_dtype", "compute_dtype", "logits_dtype", "cbow",
+                    "step_lowering", "device_pairgen", "nonfinite_policy",
+                    "norm_watch", "norm_watch_threshold", "norm_watch_max",
+                    "norm_watch_frac", "heartbeat_every_steps")})
 
     def _stop_profiler(self) -> None:
         if getattr(self, "_profiling", False):
@@ -2169,20 +2258,43 @@ class Trainer:
     # overlap.
     _ROLLBACK_STEP_JUMP = 1 << 22
 
-    def _params_finite(self) -> bool:
-        if self._finite_fn is None:
-            self._finite_fn = jax.jit(
-                lambda p: jnp.isfinite(p.syn0).all() & jnp.isfinite(p.syn1).all())
-        # Drain in-flight chunk dispatches BEFORE launching the probe. On a
-        # multi-device mesh the probe's cross-shard reduction is itself a
-        # collective-bearing program; dispatching it while a chunk is still
-        # at its collective rendezvous puts two independent collective
-        # programs in flight — the XLA:CPU rendezvous-starvation deadlock
-        # documented at _sync_collectives in __init__. Waiting on the carry
-        # is the sync the heartbeat fetch was already paying, so
-        # steady-state cost is unchanged.
+    def _health_stats(self) -> dict:
+        """Run the fused on-device health probe (obs/probe.py) and return its
+        channel dict: the old finiteness bit PLUS per-matrix row-norm
+        channels (max/mean/p99, frac over the watchdog threshold) from ONE
+        reduction pass, and the host-side update-magnitude proxy (delta of
+        mean_norm between consecutive probes).
+
+        Drains in-flight chunk dispatches BEFORE launching the probe: on a
+        multi-device mesh the probe's cross-shard reductions are themselves a
+        collective-bearing program; dispatching it while a chunk is still at
+        its collective rendezvous puts two independent collective programs in
+        flight — the XLA:CPU rendezvous-starvation deadlock documented at
+        _sync_collectives in __init__. Waiting on the carry is the sync the
+        heartbeat fetch was already paying, so steady-state cost is
+        unchanged. The result is fetched EXPLICITLY (jax.device_get) so the
+        probe stays clean under the stepaudit transfer contract
+        (tools/stepaudit.py runs scripted fits under jax.transfer_guard)."""
+        if self._health_fn is None:
+            from glint_word2vec_tpu.obs.probe import make_health_probe
+            self._health_fn = make_health_probe(
+                self.vocab.size, self.config.norm_watch_threshold)
+        from glint_word2vec_tpu.obs.probe import stats_to_channels
         jax.block_until_ready(self.params)
-        return bool(self._finite_fn(self.params))
+        with self._tracer.span("health_probe"):
+            channels = stats_to_channels(
+                jax.device_get(self._health_fn(self.params)))
+        prev = self._last_probe_channels
+        if prev is not None:
+            channels["update_mag"] = round(
+                abs(channels["syn0"]["mean_norm"] - prev["syn0"]["mean_norm"])
+                + abs(channels["syn1"]["mean_norm"]
+                      - prev["syn1"]["mean_norm"]), 9)
+        self._last_probe_channels = channels
+        return channels
+
+    def _params_finite(self) -> bool:
+        return bool(self._health_stats()["finite"])
 
     def _copy_params(self, params: EmbeddingPair) -> EmbeddingPair:
         if self._copy_params_fn is None:
@@ -2203,12 +2315,15 @@ class Trainer:
             f"for {self.config.param_dtype}. Set nonfinite_policy='rollback' "
             f"to auto-recover from the last good snapshot instead of halting")
 
-    def _nonfinite_guard(self) -> None:
+    def _nonfinite_guard(self, channels: Optional[dict] = None) -> None:
         """Heartbeat-cadence finiteness guardrail (config.nonfinite_policy).
-        The probe is a separate tiny jitted reduction over the params carry,
-        fetched alongside the heartbeat's metrics fetch (which already forces
-        a device sync) — the training step functions are untouched, so the
-        fast metrics-elided twin stays elided. On a finite probe under
+        The probe is a separate jitted reduction over the params carry (the
+        fused health probe, obs/probe.py — finiteness plus the norm channels
+        in one pass), fetched alongside the heartbeat's metrics fetch (which
+        already forces a device sync) — the training step functions are
+        untouched, so the fast metrics-elided twin stays elided. ``channels``
+        lets a caller that already probed this round (the watchdog/heartbeat
+        path in _finish_round) share the fetch. On a finite probe under
         ``rollback``, the current params are snapshotted into the ring; on a
         non-finite probe the policy decides: ``halt`` raises with a
         diagnostic, ``rollback`` pops and restores the newest good snapshot
@@ -2219,7 +2334,9 @@ class Trainer:
         next finite probe step back through the older ring entries; an
         emptied ring raises."""
         cfg = self.config
-        if self._params_finite():
+        if channels is None:
+            channels = self._health_stats()
+        if channels["finite"]:
             if cfg.nonfinite_policy == "rollback":
                 self._snapshot_ring.append(
                     (self._copy_params(self.params), self.global_step))
@@ -2262,6 +2379,72 @@ class Trainer:
             "rollback %d/%d)", old_step, snap_step, self.global_step,
             self.rollbacks_performed, self.config.max_rollbacks)
 
+    def _watchdog_check(self, channels: dict) -> None:
+        """Feed one probe result to the finite-blowup watchdog and persist any
+        firing to the telemetry sink — for ``halt`` the record is emitted
+        BEFORE the raise, so the run log carries the evidence the exception
+        message summarizes."""
+        from glint_word2vec_tpu.train.faults import NormBlowupError
+        try:
+            reason = self.norm_watchdog.check(channels, self.global_step)
+        except NormBlowupError:
+            if self._telemetry is not None:
+                self._telemetry.emit(
+                    "watchdog", step=self.global_step, policy="halt",
+                    reason=self.norm_watchdog.last_reason or "",
+                    channels=channels)
+            raise
+        if reason and self._telemetry is not None:
+            self._telemetry.emit(
+                "watchdog", step=self.global_step,
+                policy=self.config.norm_watch, reason=reason,
+                channels=channels)
+
+    def _end_run(self, status: str) -> None:
+        """Emit the run_end record + export the Chrome trace (idempotent per
+        _start_run_bookkeeping). The success path calls this AFTER the final
+        checkpoint save so that save's span lands in the exported trace; the
+        error path reaches it through _finish_run_telemetry in the fit
+        ``finally`` blocks."""
+        if getattr(self, "_run_ended", True):
+            return
+        self._run_ended = True
+        if self._telemetry is not None:
+            self._telemetry.emit(
+                "run_end", run_id=self._run_id, status=status,
+                steps=int(self.global_step),
+                pairs_trained=float(self.pairs_trained),
+                host_wait_s_total=round(self.host_wait_time, 3),
+                dispatch_s_total=round(self.dispatch_time, 3),
+                watchdog_fires=int(self.norm_watchdog.fires),
+                rollbacks=int(self.rollbacks_performed),
+                spans=self._tracer.span_summary())
+            try:
+                self.export_trace(self.config.telemetry_path + ".trace.json")
+            except OSError as e:
+                # best-effort like the sink — and _end_run runs inside the
+                # abort path's except clause, where a raise here would MASK
+                # the original training exception
+                logger.warning("trace export failed: %s", e)
+
+    def export_trace(self, path: str) -> int:
+        """Export the collected host trace spans as a Chrome-trace JSON file
+        (Perfetto / chrome://tracing loadable); returns the event count. Runs
+        automatically at run end when telemetry is on; callable any time for
+        an on-demand snapshot of a live run."""
+        return self._tracer.export_chrome_trace(path)
+
+    def _abort_run(self) -> None:
+        """Sits in every fit path's ``except BaseException: ...; raise``:
+        run_end with status="error" before the raise unwinds (guardrail
+        halt, watchdog halt, feed error). An ``except`` clause — NOT
+        ``sys.exc_info()`` in the ``finally`` — because exc_info also
+        reports an OUTER handled exception (fit() called inside an except
+        block, e.g. the crash-recovery resume pattern) and would mislabel a
+        successful recovery fit as an error. The success path emits after
+        the final checkpoint save instead (see _end_run)."""
+        self._end_run("error")
+
     def _finish_round(
         self,
         real: int,
@@ -2290,40 +2473,96 @@ class Trainer:
                     p.syn0.at[0, 0].set(jnp.asarray(jnp.nan, p.syn0.dtype)),
                     p.syn1))
             self.params = self._poison_fn(self.params)
+        scale = faults.take_scale_injection(self.global_step)
+        if scale:
+            if self._scale_fn is None:
+                self._scale_fn = jax.jit(lambda p, f: jax.tree.map(
+                    lambda x: x * f.astype(x.dtype), p))
+            self.params = self._scale_fn(self.params, jnp.float32(scale))
         faults.crash_at_step(self.global_step)
+
+        # jax.profiler window (config.profile_steps): stop the trace once the
+        # configured number of steps completed after fit start
+        if (self._profiling and cfg.profile_steps
+                and self.global_step - self._profile_start_step
+                >= cfg.profile_steps):
+            self._stop_profiler()
+            logger.info("jax.profiler window closed after %d steps",
+                        self.global_step - self._profile_start_step)
 
         ckpt_due = bool(checkpoint_path and checkpoint_every_steps
                         and self.global_step % checkpoint_every_steps < real)
         hb_due = (self.global_step - self._last_log_step
                   >= cfg.heartbeat_every_steps)
+        # ONE fused probe per probing round (obs/probe.py): finiteness for the
+        # guardrail + the norm channels for the watchdog and the heartbeat
+        channels: Optional[dict] = None
+        if hb_due and (cfg.nonfinite_policy != "none"
+                       or cfg.norm_watch != "off"
+                       or self._telemetry is not None):
+            channels = self._health_stats()
         if cfg.nonfinite_policy != "none" and hb_due and not ckpt_due:
             # heartbeat-cadence probe; checkpoint rounds are covered by the
             # guard inside save_checkpoint itself (every save — periodic AND
             # the end-of-fit finished save — is probed exactly once, so a
             # blown-up state never overwrites the on-disk good checkpoint)
-            self._nonfinite_guard()
+            self._nonfinite_guard(channels)
+        if channels is not None and channels["finite"]:
+            # the finite-blowup watchdog (config.norm_watch, obs/watch.py):
+            # only meaningful on a finite carry — a non-finite one is the
+            # guardrail's jurisdiction above (inf rows would trivially trip
+            # every norm channel on the way down a rollback)
+            self._watchdog_check(channels)
 
         if hb_due:
             now = time.perf_counter()
             pps = self._pairs_since_log / max(now - self._last_log_time, 1e-9)
             self._pairs_since_log = 0.0
+            # EXPLICIT fetch of the [K]-sized metric vectors, then host-side
+            # indexing: device-side `metrics.loss[real - 1]` dispatches a
+            # gather whose index operand rides an IMPLICIT int32 host→device
+            # transfer — the regression class the stepaudit transfer guard
+            # disallows, reachable here only on heartbeat rounds (which the
+            # audit's scripted fits are too short to hit; tests/test_obs.py
+            # runs a probing fit under the guard to keep this path honest)
+            loss_k, fpos_k = jax.device_get(
+                (metrics.loss, metrics.mean_f_pos))
             rec = HeartbeatRecord(
                 words=self.state.words_processed,
                 alpha=float(alphas[real - 1]),
-                loss=float(metrics.loss[real - 1]),
-                mean_f_pos=float(metrics.mean_f_pos[real - 1]),
-                pairs_per_sec=pps)
+                loss=float(loss_k[real - 1]),
+                mean_f_pos=float(fpos_k[real - 1]),
+                pairs_per_sec=pps,
+                global_step=self.global_step,
+                host_wait_s=self.host_wait_time - self._last_hb_host_wait,
+                dispatch_s=self.dispatch_time - self._last_hb_dispatch,
+                norms=channels)
+            self._last_hb_host_wait = self.host_wait_time
+            self._last_hb_dispatch = self.dispatch_time
             self.heartbeats.append(rec)
             logger.info(
                 "wordCount = %d, alpha = %.6f, loss = %.4f, fPlus = %.4f, "
                 "pairs/s = %.0f", rec.words, rec.alpha, rec.loss,
                 rec.mean_f_pos, rec.pairs_per_sec)
+            if self._telemetry is not None:
+                self._telemetry.emit(
+                    "heartbeat", step=rec.global_step, words=rec.words,
+                    alpha=rec.alpha, loss=rec.loss,
+                    mean_f_pos=rec.mean_f_pos,
+                    pairs_per_sec=round(rec.pairs_per_sec, 3),
+                    host_wait_s=round(rec.host_wait_s, 6),
+                    dispatch_s=round(rec.dispatch_s, 6),
+                    **({"norms": channels} if channels is not None else {}))
             if on_heartbeat is not None:
                 on_heartbeat(rec)
             self._last_log_time, self._last_log_step = now, self.global_step
 
         if ckpt_due:
-            self.save_checkpoint(checkpoint_path)
+            # share this round's probe fetch with the save-side guard — the
+            # params are unchanged since _health_stats above, and a second
+            # full [V, D] reduction + sync per coincident round is the probe
+            # cost this method's single-probe rule exists to avoid
+            self.save_checkpoint(checkpoint_path, _channels=channels)
 
     def _fit_sharded(
         self,
@@ -2479,10 +2718,11 @@ class Trainer:
                 if pending:
                     yield flush()
 
+        lstream = self._tracer.wrap_iter("producer", local_stream())
         if cfg.prefetch_chunks > 0:
-            chunks = _threaded_iter(local_stream(), cfg.prefetch_chunks)
+            chunks = _threaded_iter(lstream, cfg.prefetch_chunks)
         else:
-            chunks = iter(local_stream())
+            chunks = iter(lstream)
 
         clock = float(self.state.words_processed)
         cur_iter, cur_batches = start_iter, skip
@@ -2547,12 +2787,13 @@ class Trainer:
 
                 if cfg.feed_consistency_check:
                     self._assert_feed_consistent(feed, meta)
-                stacked = put_global(self._chunk_shardings, feed)
-                meta_dev, base_dev = self._stage_dispatch_meta(
-                    meta, self.global_step + 1)
-                self.params, metrics = self._dispatch_step_fn(real)(
-                    self.params, stacked, meta_dev, base_dev,
-                    self._table_prob, self._table_alias)
+                with self._tracer.span("dispatch"):
+                    stacked = put_global(self._chunk_shardings, feed)
+                    meta_dev, base_dev = self._stage_dispatch_meta(
+                        meta, self.global_step + 1)
+                    self.params, metrics = self._dispatch_step_fn(real)(
+                        self.params, stacked, meta_dev, base_dev,
+                        self._table_prob, self._table_alias)
                 self.dispatch_time += time.perf_counter() - t0
                 self._after_dispatch()
                 self._finish_round(
@@ -2568,6 +2809,9 @@ class Trainer:
                         shard_progress=[[int(a), int(b_)] for a, b_ in g["prog"]],
                         shard_feed="pairs"),
                     checkpoint_path, checkpoint_every_steps, on_heartbeat)
+        except BaseException:
+            self._abort_run()  # its docstring has the why-not-sys.exc_info
+            raise
         finally:
             self._stop_profiler()
             closer = getattr(chunks, "close", None)
@@ -2580,6 +2824,7 @@ class Trainer:
             finished=True, global_step=self.global_step)
         if checkpoint_path:
             self.save_checkpoint(checkpoint_path)
+        self._end_run("ok")
         return self.params
 
     def _batch_stream(self, sentences: Sequence[np.ndarray], iteration: int):
@@ -2607,12 +2852,16 @@ class Trainer:
         return EmbeddingPair(syn0=self.params.syn0[:V, :D],
                              syn1=self.params.syn1[:V, :D])
 
-    def save_checkpoint(self, path: str) -> None:
+    def save_checkpoint(self, path: str,
+                        _channels: Optional[dict] = None) -> None:
         if self.config.nonfinite_policy != "none":
             # every save — periodic and the finished end-of-fit one — runs the
             # guardrail first: 'halt' refuses to replace the last good on-disk
-            # checkpoint with NaNs, 'rollback' saves the restored snapshot
-            self._nonfinite_guard()
+            # checkpoint with NaNs, 'rollback' saves the restored snapshot.
+            # _channels: a probe result fetched THIS round with no dispatch
+            # since (the coincident heartbeat+checkpoint round) — reused so
+            # the round pays one probe, not two
+            self._nonfinite_guard(_channels)
         from glint_word2vec_tpu.parallel.distributed import is_multiprocess
         if self.config.sharded_checkpoint or is_multiprocess():
             # row-shards layout: each process writes its own rows, no host gather

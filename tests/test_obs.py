@@ -1,0 +1,436 @@
+"""Observability-layer suite (docs/observability.md): telemetry JSONL schema
+round-trip + rotation, span nesting/thread-safety + Chrome-trace export, the
+fused health probe vs a NumPy oracle, the finite-blowup watchdog under both
+policies, the bounded heartbeat ring, and the compiled-step contract proof
+that a probing fit adds no implicit transfers and no extra step-twin
+recompile (the stepaudit discipline, exercised in-process with the probe
+actually firing)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.pipeline import encode_sentences
+from glint_word2vec_tpu.data.vocab import build_vocab
+from glint_word2vec_tpu.obs.probe import make_health_probe, stats_to_channels
+from glint_word2vec_tpu.obs.schema import (
+    SCHEMA_VERSION,
+    validate_file,
+    validate_record,
+)
+from glint_word2vec_tpu.obs.sink import TelemetrySink
+from glint_word2vec_tpu.obs.spans import Tracer
+from glint_word2vec_tpu.obs.watch import NormWatchdog
+from glint_word2vec_tpu.ops.sgns import EmbeddingPair
+from glint_word2vec_tpu.train import faults
+from glint_word2vec_tpu.train.faults import NormBlowupError
+from glint_word2vec_tpu.train.trainer import Trainer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _toy_trainer(seed=0, n=250, **cfg_kw):
+    rng = np.random.default_rng(seed)
+    sents = [[f"w{i}" for i in rng.integers(0, 30, 20)] for _ in range(n)]
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=8, pairs_per_batch=128, window=3,
+                         num_iterations=2, steps_per_dispatch=2,
+                         heartbeat_every_steps=2, subsample_ratio=0.0,
+                         prefetch_chunks=0, seed=1, **cfg_kw)
+    return Trainer(cfg, vocab), encode_sentences(sents, vocab, 1000)
+
+
+# -- schema + sink ---------------------------------------------------------------------
+
+
+def test_sink_roundtrip_schema_valid(tmp_path):
+    """Every record kind the trainer emits must validate against the
+    catalogue, version field included, after a disk round-trip."""
+    p = str(tmp_path / "run.jsonl")
+    with TelemetrySink(p) as sink:
+        sink.emit("run_start", run_id="r1", vocab_size=30, mesh=[1, 1],
+                  config={"learning_rate": 0.02})
+        sink.emit("heartbeat", step=4, words=100, alpha=0.02, loss=1.5,
+                  mean_f_pos=0.4, pairs_per_sec=1e5, host_wait_s=0.1,
+                  dispatch_s=0.2, norms={"finite": True})
+        sink.emit("watchdog", step=4, policy="warn", reason="x",
+                  channels={"syn0": {"max_norm": 1e4}})
+        sink.emit("run_end", run_id="r1", status="ok", steps=4,
+                  pairs_trained=512.0, host_wait_s_total=0.1,
+                  dispatch_s_total=0.2, watchdog_fires=1)
+    summary = validate_file(p)
+    assert summary["ok"], summary["errors"]
+    assert summary["kinds"] == {"run_start": 1, "heartbeat": 1,
+                                "watchdog": 1, "run_end": 1}
+    with open(p) as f:
+        recs = [json.loads(line) for line in f]
+    assert all(r["schema"] == SCHEMA_VERSION for r in recs)
+    assert all("t" in r for r in recs)
+
+
+def test_schema_rejects_drift():
+    ok = {"schema": SCHEMA_VERSION, "kind": "heartbeat", "t": 1.0, "step": 1,
+          "words": 10, "alpha": 0.1, "loss": 1.0, "mean_f_pos": 0.5,
+          "pairs_per_sec": 1.0, "host_wait_s": 0.0, "dispatch_s": 0.0}
+    assert validate_record(ok) == []
+    assert validate_record({**ok, "schema": SCHEMA_VERSION + 1})  # version drift
+    bad = dict(ok)
+    del bad["loss"]
+    assert any("loss" in e for e in validate_record(bad))  # field removal
+    assert validate_record({**ok, "step": "four"})         # type change
+    assert validate_record({**ok, "kind": "mystery"})      # unknown kind
+    # additive evolution stays legal
+    assert validate_record({**ok, "new_field": 123}) == []
+
+
+def test_sink_rotation_bounded(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    sink = TelemetrySink(p, rotate_bytes=2000, keep=2)
+    for i in range(200):
+        sink.emit("watchdog", step=i, policy="warn", reason="r" * 50,
+                  channels={})
+    sink.close()
+    files = sorted(os.listdir(tmp_path))
+    assert "run.jsonl" in files
+    assert "run.jsonl.1" in files
+    assert "run.jsonl.2" in files
+    assert "run.jsonl.3" not in files  # keep=2 bounds the rotated segments
+    for f in files:
+        assert os.path.getsize(tmp_path / f) <= 2000 + 200
+        assert validate_file(str(tmp_path / f))["ok"]
+
+
+def test_sink_thread_safety(tmp_path):
+    """Concurrent emitters must never interleave mid-line (each record is one
+    write under the lock)."""
+    p = str(tmp_path / "run.jsonl")
+    sink = TelemetrySink(p)
+
+    def emit_many(tid):
+        for i in range(100):
+            sink.emit("watchdog", step=i, policy="warn",
+                      reason=f"t{tid}" * 20, channels={"tid": tid})
+
+    threads = [threading.Thread(target=emit_many, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.close()
+    summary = validate_file(p)
+    assert summary["ok"], summary["errors"][:3]
+    assert summary["records"] == 400
+
+
+def test_sink_sanitizes_nonfinite(tmp_path):
+    """Non-finite measured values (a diverging run's NaN loss) must land as
+    null, never as RFC-8259-invalid bare NaN/Infinity tokens — strict
+    consumers (jq) read the run log of exactly those runs."""
+    p = str(tmp_path / "run.jsonl")
+    with TelemetrySink(p) as sink:
+        sink.emit("heartbeat", step=1, words=1, alpha=0.1, loss=float("nan"),
+                  mean_f_pos=float("inf"), pairs_per_sec=1.0,
+                  host_wait_s=0.0, dispatch_s=0.0,
+                  norms={"syn0": {"max_norm": float("-inf")}})
+    line = open(p).read()
+    assert "NaN" not in line and "Infinity" not in line
+    rec = json.loads(line)
+    assert rec["loss"] is None and rec["mean_f_pos"] is None
+    assert rec["norms"]["syn0"]["max_norm"] is None
+    assert validate_record(rec) == []
+
+
+# -- spans -----------------------------------------------------------------------------
+
+
+def test_span_nesting_and_export(tmp_path):
+    tr = Tracer(enabled=True)
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+        with tr.span("inner"):
+            pass
+    evs = tr.events()
+    assert [e["name"] for e in evs] == ["inner", "inner", "outer"]
+    outer = evs[2]
+    for inner in evs[:2]:
+        # containment: inner spans sit inside the outer's [ts, ts+dur] window
+        assert inner["ts_s"] >= outer["ts_s"] - 1e-9
+        assert (inner["ts_s"] + inner["dur_s"]
+                <= outer["ts_s"] + outer["dur_s"] + 1e-9)
+    p = str(tmp_path / "trace.json")
+    assert tr.export_chrome_trace(p) == 3
+    with open(p) as f:
+        doc = json.load(f)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"outer", "inner"}
+    assert all(set(e) >= {"ph", "name", "pid", "tid", "ts", "dur"} for e in xs)
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert metas and metas[0]["name"] == "thread_name"
+
+
+def test_span_thread_safety_and_tids():
+    tr = Tracer(enabled=True)
+    barrier = threading.Barrier(4)  # all 4 alive at once: thread idents are
+                                    # only unique among LIVE threads
+
+    def work(i):
+        barrier.wait()
+        for _ in range(50):
+            with tr.span(f"thread{i}"):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = tr.events()
+    assert len(evs) == 200
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], set()).add(e["tid"])
+    # each span name was recorded on exactly its own thread
+    assert all(len(tids) == 1 for tids in by_name.values())
+    assert len({next(iter(t)) for t in by_name.values()}) == 4
+    summary = tr.span_summary()
+    assert all(summary[f"thread{i}"]["count"] == 50 for i in range(4))
+
+
+def test_span_disabled_is_noop_and_bounded():
+    tr = Tracer(enabled=False)
+    with tr.span("x"):
+        pass
+    assert tr.events() == []
+    tr2 = Tracer(enabled=True, max_events=10)
+    for i in range(25):
+        with tr2.span(f"s{i}"):
+            pass
+    evs = tr2.events()
+    assert len(evs) == 10
+    assert evs[0]["name"] == "s15"  # oldest dropped, tail kept
+
+
+# -- fused health probe vs NumPy oracle ------------------------------------------------
+
+
+def test_probe_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    V, Vpad, D = 500, 512, 16
+    threshold = 10.0
+    syn0 = rng.normal(size=(Vpad, D)).astype(np.float32)
+    syn1 = rng.normal(size=(Vpad, D)).astype(np.float32)
+    syn0[50] *= 1e4    # a runaway row
+    syn0[60:80] *= 40  # a hot subset past the threshold
+    syn0[V:] = 0.0     # padding must not contaminate any channel
+    syn1[V:] = 0.0
+    params = EmbeddingPair(jax.numpy.asarray(syn0), jax.numpy.asarray(syn1))
+    probe = make_health_probe(V, threshold)
+    ch = stats_to_channels(jax.device_get(probe(params)))
+    assert ch["finite"] is True
+    for name, mat in (("syn0", syn0), ("syn1", syn1)):
+        norms = np.linalg.norm(mat[:V].astype(np.float64), axis=1)
+        got = ch[name]
+        assert got["max_norm"] == pytest.approx(norms.max(), rel=1e-5)
+        assert got["mean_norm"] == pytest.approx(norms.mean(), rel=1e-5)
+        assert got["frac_over"] == pytest.approx(
+            float((norms > threshold).mean()), abs=1e-7)
+        # histogram p99 is exact to one quarter-octave bucket: the true p99
+        # lies in (p99/2^0.25, p99]
+        true_p99 = np.quantile(norms, 0.99, method="inverted_cdf")
+        assert got["p99_norm"] >= true_p99 * (1 - 1e-6)
+        assert got["p99_norm"] <= true_p99 * 2 ** 0.25 * (1 + 1e-6)
+
+
+def test_probe_finite_bit_matches_old_semantics():
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(64, 8)).astype(np.float32)
+    params = EmbeddingPair(jax.numpy.asarray(a), jax.numpy.asarray(a))
+    probe = make_health_probe(60, 100.0)
+    assert stats_to_channels(jax.device_get(probe(params)))["finite"] is True
+    b = a.copy()
+    b[63, 7] = np.nan  # in the PADDING rows — finiteness covers the whole carry
+    params = EmbeddingPair(jax.numpy.asarray(a), jax.numpy.asarray(b))
+    assert stats_to_channels(jax.device_get(probe(params)))["finite"] is False
+
+
+# -- watchdog --------------------------------------------------------------------------
+
+
+def _channels(max_norm=1.0, frac=0.0):
+    m = {"max_norm": max_norm, "mean_norm": 1.0, "p99_norm": 1.0,
+         "frac_over": frac}
+    return {"finite": True, "syn0": dict(m), "syn1": dict(m)}
+
+
+def test_watchdog_unit_thresholds():
+    wd = NormWatchdog("warn", threshold=100.0, max_norm=1000.0, frac=0.01)
+    assert wd.check(_channels(), step=1) is None
+    assert wd.check(_channels(max_norm=999.0, frac=0.0099), step=2) is None
+    assert wd.fires == 0
+    assert wd.check(_channels(frac=0.02), step=3)
+    assert wd.check(_channels(max_norm=2000.0), step=4)
+    assert wd.fires == 2
+    wd_halt = NormWatchdog("halt", 100.0, 1000.0, 0.01)
+    with pytest.raises(NormBlowupError, match="finite norm blowup"):
+        wd_halt.check(_channels(max_norm=5000.0), step=5)
+    wd_off = NormWatchdog("off", 100.0, 1000.0, 0.01)
+    assert wd_off.check(_channels(max_norm=1e9), step=6) is None
+
+
+def test_injected_blowup_warn_fires_nonfinite_silent(tmp_path):
+    """The acceptance scenario: a scripted FINITE blowup
+    (faults.scale_params_at_step). norm_watch='warn' fires and finishes;
+    nonfinite_policy='halt' alone must never notice (no NaN exists)."""
+    run_log = str(tmp_path / "run.jsonl")
+    faults.configure(scale_params_at_step=8)
+    trainer, enc = _toy_trainer(norm_watch="warn", nonfinite_policy="halt",
+                                telemetry_path=run_log)
+    trainer.fit(enc)  # no raise: the guardrail stays silent, warn continues
+    assert trainer.norm_watchdog.fires >= 1
+    assert np.isfinite(np.asarray(trainer.params.syn0)).all()
+    summary = validate_file(run_log)
+    assert summary["ok"], summary["errors"][:3]
+    assert summary["kinds"].get("watchdog", 0) >= 1
+    with open(run_log) as f:
+        wd = [json.loads(line) for line in f
+              if '"kind": "watchdog"' in line]
+    assert wd[0]["policy"] == "warn"
+    assert wd[0]["channels"]["syn0"]["max_norm"] > 1000.0
+
+
+def test_injected_blowup_halt_raises(tmp_path):
+    run_log = str(tmp_path / "run.jsonl")
+    faults.configure(scale_params_at_step=8)
+    trainer, enc = _toy_trainer(norm_watch="halt", telemetry_path=run_log)
+    with pytest.raises(NormBlowupError, match="finite norm blowup"):
+        trainer.fit(enc)
+    # the halt record was emitted BEFORE the raise, and run_end carries error
+    with open(run_log) as f:
+        recs = [json.loads(line) for line in f]
+    kinds = [r["kind"] for r in recs]
+    assert "watchdog" in kinds
+    assert recs[-1]["kind"] == "run_end" and recs[-1]["status"] == "error"
+
+
+def test_norm_watch_off_default_and_validation():
+    assert Word2VecConfig().norm_watch == "off"
+    with pytest.raises(ValueError, match="norm_watch"):
+        Word2VecConfig(norm_watch="panic")
+    with pytest.raises(ValueError, match="norm_watch_frac"):
+        Word2VecConfig(norm_watch_frac=0.0)
+    with pytest.raises(ValueError, match="heartbeat_ring"):
+        Word2VecConfig(heartbeat_ring=0)
+
+
+# -- bounded heartbeat ring ------------------------------------------------------------
+
+
+def test_heartbeat_ring_bounded(tmp_path):
+    run_log = str(tmp_path / "run.jsonl")
+    trainer, enc = _toy_trainer(heartbeat_ring=4, telemetry_path=run_log)
+    trainer.fit(enc)
+    assert trainer.heartbeats.maxlen == 4
+    assert len(trainer.heartbeats) == 4
+    # the ring keeps the newest records; the sink file keeps the full history
+    summary = validate_file(run_log)
+    assert summary["kinds"]["heartbeat"] > 4
+    steps = [r.global_step for r in trainer.heartbeats]
+    assert steps == sorted(steps)
+    # the ring holds the NEWEST records (the final round may not reach the
+    # next heartbeat cadence, so exact equality is not guaranteed)
+    assert (trainer.global_step - trainer.heartbeats[-1].global_step
+            < trainer.config.heartbeat_every_steps
+            + trainer.config.steps_per_dispatch)
+    # extended fields ride every record
+    hb = trainer.heartbeats[-1]
+    assert hb.norms is not None and "syn0" in hb.norms
+    assert hb.host_wait_s >= 0.0 and hb.dispatch_s >= 0.0
+
+
+def test_tracer_disarmed_by_telemetry_off_trainer(tmp_path):
+    """The process-wide tracer must be DISARMED by a telemetry-off trainer
+    constructed after a telemetry-on one — otherwise the overhead A/B's off
+    arm silently records spans into the shared ring (biasing the very metric
+    the acceptance bar reads) and long-lived processes accumulate events."""
+    from glint_word2vec_tpu.obs.spans import default_tracer
+    _toy_trainer(telemetry_path=str(tmp_path / "a.jsonl"))
+    assert default_tracer().enabled
+    _toy_trainer()
+    assert not default_tracer().enabled
+
+
+def test_run_end_ok_when_fit_called_inside_except_block(tmp_path):
+    """A successful fit launched from inside an except handler (the
+    crash-recovery resume pattern) must emit run_end status='ok' — a
+    sys.exc_info()-based abort check in the fit finally would see the OUTER
+    handled exception and mislabel it."""
+    run_log = str(tmp_path / "run.jsonl")
+    try:
+        raise RuntimeError("outer handled failure")
+    except RuntimeError:
+        trainer, enc = _toy_trainer(telemetry_path=run_log)
+        trainer.fit(enc, checkpoint_path=str(tmp_path / "ck"),
+                    checkpoint_every_steps=8)
+    with open(run_log) as f:
+        recs = [json.loads(line) for line in f]
+    assert recs[-1]["kind"] == "run_end"
+    assert recs[-1]["status"] == "ok"
+
+
+# -- compiled-step contracts with the probe firing -------------------------------------
+
+
+def test_probe_no_implicit_transfers_no_extra_recompile(tmp_path):
+    """The stepaudit discipline with telemetry ON and the probe actually
+    firing (the audit's scripted fits never reach a heartbeat, so this is the
+    coverage for the probing path): the whole fit runs under
+    jax.transfer_guard('disallow') — the probe's device fetch is explicit
+    (jax.device_get) and its inputs are the already-staged params carry — and
+    the two step twins still compile exactly once (the probe is its own tiny
+    program, never a step-twin signature change)."""
+    trainer, enc = _toy_trainer(
+        telemetry_path=str(tmp_path / "run.jsonl"), norm_watch="warn")
+    with jax.transfer_guard("disallow"):
+        trainer.fit(enc)
+    assert len(trainer.heartbeats) > 0  # the probe really ran under the guard
+    compiles = trainer._step_fn._cache_size()
+    if trainer._step_fn_fast is not trainer._step_fn:
+        compiles += trainer._step_fn_fast._cache_size()
+    assert compiles == 1
+
+
+# -- scripted telemetry fit through the CLI driver -------------------------------------
+
+
+def test_telemetry_run_smoke(tmp_path):
+    """End-to-end acceptance: tools/telemetry_run.py --smoke produces a
+    schema-valid JSONL run log + a Chrome trace with the required spans, and
+    prints exactly one JSON line (R7)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "telemetry_run.py"),
+         "--smoke", "--out", str(tmp_path / "art")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=_REPO, capture_output=True, timeout=500, text=True)
+    assert proc.returncode == 0, proc.stdout[-1000:] + proc.stderr[-1000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    res = json.loads(lines[0])
+    assert res["ok"] and res["schema_valid"]
+    assert res["missing_spans"] == []
+    assert os.path.exists(res["run_log"])
+    assert os.path.exists(res["trace"])

@@ -16,7 +16,11 @@ Every fault is deterministic (train/faults.py) — no sleep/kill-timing races:
 3. **nan-rollback / nan-halt** — NaN is injected into the params carry at a
    scripted step; under ``nonfinite_policy="rollback"`` the run finishes with
    finite embeddings, under ``"halt"`` it fails fast with a diagnostic.
-4. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
+4. **norm-blowup** — the params carry is scaled by 1e6 at a scripted step: a
+   FINITE blowup (the measured 1.6M-vocab collapse signature, ROADMAP item 2).
+   ``nonfinite_policy`` alone must stay silent, ``norm_watch="warn"`` must
+   record firings and finish, ``norm_watch="halt"`` must fail fast.
+5. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
    exponential-backoff wrapper in ``data/`` must absorb them.
 
 Usage::
@@ -51,12 +55,12 @@ def toy_sentences(n_sentences: int, seed: int = 0):
             for _ in range(n_sentences)]
 
 
-def toy_config(policy: str = "halt"):
+def toy_config(policy: str = "halt", **kw):
     from glint_word2vec_tpu.config import Word2VecConfig
     return Word2VecConfig(
         vector_size=8, pairs_per_batch=128, window=3, num_iterations=2,
         steps_per_dispatch=2, heartbeat_every_steps=2, subsample_ratio=0.0,
-        prefetch_chunks=0, seed=1, nonfinite_policy=policy)
+        prefetch_chunks=0, seed=1, nonfinite_policy=policy, **kw)
 
 
 def _fit(sentences, cfg, **kw):
@@ -161,6 +165,51 @@ def phase_nan(policy: str) -> str:
     return ""
 
 
+def phase_norm_blowup() -> str:
+    """The finite-blowup watchdog (ISSUE 6 / ROADMAP item 2): scale the params
+    carry by 1e6 mid-run — a FINITE norm blowup, the measured 1.6M-vocab
+    collapse signature. The non-finite guardrail alone must stay silent (no
+    NaN ever appears — exactly the round-5 blindness), norm_watch='warn' must
+    record firings and finish, norm_watch='halt' must fail fast."""
+    from glint_word2vec_tpu.train import faults
+    from glint_word2vec_tpu.train.faults import NormBlowupError
+
+    # 1. nonfinite halt alone: silent (the blowup is finite)
+    faults.configure(scale_params_at_step=8)
+    try:
+        trainer = _fit(toy_sentences(200, seed=2), toy_config("halt"))
+    except Exception as e:  # noqa: BLE001 — any raise here is the failure
+        return f"nonfinite_policy='halt' fired on a FINITE blowup: {e}"
+    finally:
+        faults.reset()
+    if not np.isfinite(np.asarray(trainer.params.syn0)).all():
+        return "scaled params went non-finite — injection no longer finite"
+    if trainer.norm_watchdog.fires:
+        return "watchdog fired with norm_watch='off'"
+
+    # 2. warn: fires, training continues to completion
+    faults.configure(scale_params_at_step=8)
+    try:
+        trainer = _fit(toy_sentences(200, seed=2),
+                       toy_config("halt", norm_watch="warn"))
+    finally:
+        faults.reset()
+    if trainer.norm_watchdog.fires < 1:
+        return "norm_watch='warn' never fired on the injected blowup"
+
+    # 3. halt: fail fast with the diagnostic
+    faults.configure(scale_params_at_step=8)
+    try:
+        _fit(toy_sentences(200, seed=2),
+             toy_config("halt", norm_watch="halt"))
+    except NormBlowupError as e:
+        return "" if "finite norm blowup" in str(e) else \
+            f"halt diagnostic unclear: {e}"
+    finally:
+        faults.reset()
+    return "norm_watch='halt' finished instead of raising"
+
+
 def phase_flaky_ingest(workdir: str) -> str:
     from glint_word2vec_tpu.data.corpus import encode_corpus
     from glint_word2vec_tpu.data.vocab import build_vocab
@@ -205,6 +254,7 @@ def main() -> int:
          lambda: phase_corrupt_fallback(os.path.join(workdir, "p2"))),
         ("nan-rollback", lambda: phase_nan("rollback")),
         ("nan-halt", lambda: phase_nan("halt")),
+        ("norm-blowup", phase_norm_blowup),
         ("flaky-ingest",
          lambda: phase_flaky_ingest(os.path.join(workdir, "p4"))),
     ]

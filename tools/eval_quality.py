@@ -310,6 +310,21 @@ def evaluate(words, emb: np.ndarray, index=None) -> dict:
         emb = np.where(np.isfinite(emb), emb, 0.0).astype(emb.dtype, copy=False)
     abs_max = float(np.minimum(row_max.max(), np.finfo(np.float32).max))
     blown = int((row_max > 100.0).sum())
+    # norm channels (ISSUE 6 / ROADMAP item 2): the same row-L2-norm signals
+    # the trainer's fused health probe reports (obs/probe.py), computed on the
+    # final embedding so EVAL_RUNS rows let the large-vocab ladder correlate
+    # quality collapse with the norm trajectory the watchdog thresholds watch.
+    # Computed AFTER the inf-masking above so the row stays strict JSON; the
+    # rows_inf field already counts what the mask removed. Threshold 100.0 ==
+    # the config default norm_watch_threshold (provenance in the config doc).
+    row_norm = np.linalg.norm(emb.astype(np.float64), axis=1)
+    fmax = float(np.finfo(np.float32).max)
+    norm_channels = {
+        "row_norm_max": round(float(min(row_norm.max(), fmax)), 3),
+        "row_norm_p99": round(float(min(np.percentile(row_norm, 99), fmax)), 3),
+        "row_norm_mean": round(float(min(row_norm.mean(), fmax)), 4),
+        "rows_norm_over_100": int((row_norm > 100.0).sum()),
+    }
     pur, margin = purity(emb)
     rnd = np.random.default_rng(1).standard_normal(
         emb.shape, dtype=np.float32)
@@ -319,6 +334,7 @@ def evaluate(words, emb: np.ndarray, index=None) -> dict:
         "emb_abs_max": round(abs_max, 3),
         "rows_inf": rows_inf,
         "rows_abs_over_100": blown,
+        **norm_channels,
         "purity_at_10_random_baseline": round(pur0, 4),
         "cosine_margin": round(margin, 4),
         "cosine_margin_random_baseline": round(margin0, 4),

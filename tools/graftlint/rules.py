@@ -395,6 +395,7 @@ class R7JsonStdout:
     _CONTRACT_MODULES = {
         "bench.py", "__graft_entry__.py", "tools/hostbench.py",
         "tools/collectives.py", "tools/shard_ab.py", "tools/stepaudit.py",
+        "tools/telemetry_run.py",
     }
 
     def applies(self, path: str) -> bool:

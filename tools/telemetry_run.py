@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Scripted telemetry fit: the acceptance driver for the observability layer
+(docs/observability.md) and the CI artifact producer.
+
+Runs a toy-corpus fit with telemetry ON (JSONL sink + host trace spans +
+norm watchdog armed) through the production Trainer, then:
+
+1. validates every emitted JSONL record against the schema catalogue
+   (obs/schema.py — the drift gate CI fails on);
+2. checks the exported Chrome-trace file parses and carries the
+   producer/stage/dispatch/probe/checkpoint spans;
+3. (``--overhead``) measures telemetry cost: interleaved fits with telemetry
+   off/on (3 trials each, median pairs/s) — the acceptance bar is < 2%
+   regression at heartbeat cadence.
+
+Artifacts land under ``--out`` (``run.jsonl`` + ``run.jsonl.trace.json``) so
+the CI job can upload them. Prints exactly ONE JSON line on stdout (the R7
+driver-tool contract); progress goes to stderr.
+
+Usage::
+
+    python tools/telemetry_run.py --out /tmp/telemetry [--smoke] [--overhead]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+# span names the scripted fit must produce (the acceptance list from ISSUE 6;
+# "producer" covers the feed producer, "stage_put" the staging path,
+# "health_probe" the fused probe, "checkpoint_save" the save path)
+REQUIRED_SPANS = ("producer", "stage_put", "dispatch", "health_probe",
+                  "checkpoint_save")
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def toy_sentences(n_sentences: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return [[f"w{i}" for i in rng.integers(0, 50, 20)]
+            for _ in range(n_sentences)]
+
+
+def _build(sentences, **cfg_kw):
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.train.trainer import Trainer
+    vocab = build_vocab(sentences, min_count=1)
+    enc = encode_sentences(sentences, vocab, 1000)
+    cfg = Word2VecConfig(
+        vector_size=16, pairs_per_batch=512, window=3, num_iterations=2,
+        steps_per_dispatch=4, heartbeat_every_steps=8, subsample_ratio=0.0,
+        seed=1, **cfg_kw)
+    return Trainer(cfg, vocab), enc
+
+
+def scripted_fit(out_dir: str, n_sentences: int) -> dict:
+    """One telemetry-on fit; returns the artifact summary (validated)."""
+    from glint_word2vec_tpu.obs.schema import validate_file
+    run_log = os.path.join(out_dir, "run.jsonl")
+    trainer, enc = _build(
+        toy_sentences(n_sentences), telemetry_path=run_log,
+        norm_watch="warn")
+    trainer.fit(enc, checkpoint_path=os.path.join(out_dir, "ck"),
+                checkpoint_every_steps=16)
+    trace_path = run_log + ".trace.json"
+
+    summary = validate_file(run_log)
+    spans: list = []
+    trace_ok = False
+    try:
+        with open(trace_path) as f:
+            doc = json.load(f)
+        spans = sorted({e["name"] for e in doc.get("traceEvents", [])
+                        if e.get("ph") == "X"})
+        trace_ok = True
+    except (OSError, json.JSONDecodeError, KeyError) as e:
+        summary["errors"] = summary.get("errors", []) + [f"trace: {e}"]
+    missing = [s for s in REQUIRED_SPANS if s not in spans]
+    ok = bool(summary["ok"] and trace_ok and not missing
+              and summary["kinds"].get("run_start") == 1
+              and summary["kinds"].get("run_end") == 1
+              and summary["kinds"].get("heartbeat", 0) >= 1)
+    return {
+        "ok": ok,
+        "run_log": run_log,
+        "trace": trace_path,
+        "records": summary["records"],
+        "kinds": summary["kinds"],
+        "schema_valid": summary["ok"],
+        "schema_errors": summary.get("errors", [])[:5],
+        "spans": spans,
+        "missing_spans": missing,
+        "steps": int(trainer.global_step),
+        "heartbeats_in_ring": len(trainer.heartbeats),
+    }
+
+
+def measure_overhead(n_sentences: int, trials: int = 4,
+                     workdir: str = "") -> dict:
+    """Interleaved telemetry-off/on A/B at heartbeat cadence (the PERF.md §3
+    interleaving methodology), with two noise defenses this container made
+    necessary: (1) ALTERNATING arm order per trial — a fixed off-then-on
+    order measured a phantom 5% "overhead" that was pure host drift (the
+    first fit of each pair ran hotter); (2) steady-state scoring. Geometry is
+    production-PROPORTIONED, not toy: multi-ms dispatch chunks at a 16-step
+    cadence (6x more frequent than the production default of 100) — probing
+    a microsecond-step toy fit every 2 steps measures the probe's fixed
+    cost, not the heartbeat-cadence overhead the acceptance bar is about.
+    Importable — bench.py --smoke prints this measurement as its JSON line."""
+    workdir = workdir or tempfile.mkdtemp(prefix="glint_obs_bench_")
+    # floor the corpus so every fit spans >= ~10 heartbeat windows — the
+    # steady-state scoring below needs windows to drop and windows to keep
+    n_sentences = max(n_sentences, 3000)
+    rng = np.random.default_rng(4)
+    sents = [[f"w{i}" for i in rng.integers(0, 2000, 30)]
+             for _ in range(n_sentences)]
+    geom = dict(vector_size=64, pairs_per_batch=4096, window=3,
+                num_iterations=6, steps_per_dispatch=8,
+                heartbeat_every_steps=16, subsample_ratio=0.0, seed=1)
+
+    def build(**kw):
+        from glint_word2vec_tpu.config import Word2VecConfig
+        from glint_word2vec_tpu.data.pipeline import encode_sentences
+        from glint_word2vec_tpu.data.vocab import build_vocab
+        from glint_word2vec_tpu.train.trainer import Trainer
+        vocab = build_vocab(sents, min_count=1)
+        return (Trainer(Word2VecConfig(**geom, **kw), vocab),
+                encode_sentences(sents, vocab, 1000))
+
+    # steady-state scoring: each heartbeat already reports pairs/s over its
+    # own window (probe + sink cost INCLUDED in the on-arm windows, since the
+    # probe runs before the heartbeat clock is read); the first windows carry
+    # the jit compile and are dropped. Whole-fit wall clock would fold 1-2 s
+    # of compile into a ~5 s fit and swamp a 2% bar with compile-time noise.
+    warmup = 2
+    samples = {"off": [], "on": []}
+    for trial in range(trials):
+        arms = ("off", "on") if trial % 2 == 0 else ("on", "off")
+        for arm in arms:
+            kw = {}
+            if arm == "on":
+                kw = dict(telemetry_path=os.path.join(
+                    workdir, f"run_{trial}.jsonl"), norm_watch="warn")
+            trainer, enc = build(**kw)
+            trainer.fit(enc)
+            window_pps = [hb.pairs_per_sec
+                          for hb in trainer.heartbeats][warmup:]
+            samples[arm].extend(window_pps)
+            log(f"overhead trial {trial} {arm}: "
+                f"{np.median(window_pps):,.0f} pairs/s "
+                f"({len(window_pps)} windows)")
+    off = float(np.median(samples["off"]))
+    on = float(np.median(samples["on"]))
+    spread = float(np.percentile(samples["off"], 75)
+                   / max(np.percentile(samples["off"], 25), 1e-9) - 1.0)
+    return {
+        "telemetry_off_pairs_per_sec": round(off, 1),
+        "telemetry_on_pairs_per_sec": round(on, 1),
+        # signed: a negative value means the on-arm measured FASTER, i.e. the
+        # true overhead is below this host's noise floor (see window_iqr_frac)
+        "telemetry_overhead_frac": round(1.0 - on / off, 4),
+        "window_iqr_frac": round(spread, 4),
+        "trials": trials,
+        "basis": ("median steady-state heartbeat-window pairs/s, "
+                  f"{warmup} warmup windows dropped, arm order alternated "
+                  "per trial"),
+        "windows_per_arm": len(samples["off"]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--out", default="",
+                    help="artifact directory (default: a fresh temp dir)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small corpus / fast (tier-1 + CI)")
+    ap.add_argument("--overhead", action="store_true",
+                    help="also run the interleaved telemetry-off/on "
+                         "throughput A/B")
+    args = ap.parse_args()
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="glint_telemetry_")
+    os.makedirs(out_dir, exist_ok=True)
+    n = 300 if args.smoke else 1500
+
+    log(f"telemetry_run: scripted fit -> {out_dir}")
+    result = scripted_fit(out_dir, n)
+    if args.overhead:
+        result["overhead"] = measure_overhead(
+            n, workdir=os.path.join(out_dir, "bench"))
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
