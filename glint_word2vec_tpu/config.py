@@ -165,7 +165,18 @@ class Word2VecConfig:
                                     # 128 keeping the pool-row load pairs_per_batch *
                                     # negatives / pool <= 600 — the measured 60M-word
                                     # stability rule (EVAL.md; a fixed small pool under a
-                                    # large batch provably diverges, e.g. B=64k/P=64) —
+                                    # large batch provably diverges, e.g. B=64k/P=64).
+                                    # That 600 band was CALIBRATED AT 90k VOCAB; at
+                                    # large vocabularies a pool row is re-corrected
+                                    # orders of magnitude less often and the measured
+                                    # safe band tightens to load <= 160 (EVAL.md
+                                    # round-5: load 640 collapsed purity 0.99 -> 0.14
+                                    # at 1.6M vocab, load 160 fixed it at the same
+                                    # lr). Config cannot see the vocabulary, so the
+                                    # Trainer re-resolves a STILL-AUTO pool upward at
+                                    # construction once vocab.size > 500k
+                                    # (trainer._resolve_vocab_scaled_pool; explicit
+                                    # pools are never changed, only warned about) —
                                     # except batches < 4096 pairs, which resolve to 0:
                                     # per-pair is fast enough there and shared negatives
                                     # cost quality on small corpora (toy bf16 gate)
@@ -395,7 +406,18 @@ class Word2VecConfig:
                                     # "off" (default): probe channels still
                                     # recorded when telemetry is on, nothing
                                     # fires. "warn": log + telemetry record per
-                                    # firing probe, training continues. "halt":
+                                    # firing probe, training continues.
+                                    # "recover": the self-stabilizing ladder
+                                    # (docs/robustness.md) — emit a telemetry
+                                    # recovery record, roll back to the newest
+                                    # snapshot-ring entry (the ring arms for
+                                    # ANY consumer, not only nonfinite
+                                    # rollback), re-seed the negative-sample
+                                    # counter lattice, back the lr off by
+                                    # recover_lr_backoff, engage max_row_norm
+                                    # (at norm_watch_threshold) if it was off,
+                                    # and continue — up to max_recoveries per
+                                    # fit, then degrade to "halt". "halt":
                                     # raise NormBlowupError (fail-fast, the
                                     # nonfinite_policy="halt" contract)
     norm_watch_threshold: float = 100.0  # row-L2-norm boundary of the
@@ -412,6 +434,59 @@ class Word2VecConfig:
     norm_watch_max: float = 1000.0  # hard ceiling on any single row norm —
                                     # catches a lone runaway row the fraction
                                     # channel dilutes at large vocabularies
+    # --- in-step stabilizers + watchdog auto-recovery (docs/robustness.md
+    # escalation ladder; the mitigation half of the ROADMAP-2 finite-blowup
+    # response — the knobs the watchdog diagnostic used to recommend by hand).
+    # ALL off by default: the 0.0 defaults elide every stabilizer op from the
+    # compiled step, so the default step is bit-identical to pre-stabilizer
+    # releases (tested). Implemented on every XLA step path (per-pair, shared
+    # pool, both CBOW formulations, both sharded lowerings); refused beside
+    # use_pallas (the fused kernel owns its own update math).
+    max_row_norm: float = 0.0       # > 0: per-TOUCHED-row L2 clamp applied on
+                                    # the update path after each step's
+                                    # scatter (touched rows only — NEVER a
+                                    # dense [V, D] renorm pass; ops/sgns.py
+                                    # stabilize_rows). The direct counter to
+                                    # the measured finite blowup: healthy
+                                    # trained rows sit at norm ~1-15 across
+                                    # every EVAL_RUNS config, the round-5
+                                    # collapse runs orders of magnitude past
+                                    # 100 — a clamp anywhere in [15, 100]
+                                    # bounds the channel without touching
+                                    # healthy geometry. norm_watch="recover"
+                                    # engages this at norm_watch_threshold
+                                    # when it was off
+    update_clip: float = 0.0        # > 0: per-row L2 ceiling on each pair's/
+                                    # example's update contribution (the
+                                    # d_in/d_pos SGNS rows, d_hidden/d_out
+                                    # CBOW rows), applied before the scatter-
+                                    # add. Pool-row deltas are deliberately
+                                    # exempt — under shard_map each data
+                                    # shard holds only a partial pool delta,
+                                    # so clipping there would make the
+                                    # lowerings drift; pool rows are bounded
+                                    # by the n/P reweight + max_row_norm
+                                    # (ops/sgns.py Stabilizers)
+    row_l2: float = 0.0             # > 0: L2 weight decay on touched rows —
+                                    # each touched row scales by
+                                    # (1 − alpha·row_l2) once per step
+                                    # regardless of in-batch multiplicity.
+                                    # Decay pressure scales with how often a
+                                    # row trains, exactly matching the hot-
+                                    # row mechanism of the blowup channel
+    recover_lr_backoff: float = 0.5  # norm_watch="recover": multiply the
+                                    # effective learning rate by this factor
+                                    # at each recovery (compounding across
+                                    # recoveries; applied to the dispatched
+                                    # alphas, so no step recompile). Lowering
+                                    # lr is the third measured mitigation in
+                                    # the watchdog diagnostic
+    max_recoveries: int = 4         # norm_watch="recover": recovery budget
+                                    # per fit(); exhaustion degrades to the
+                                    # "halt" contract (NormBlowupError with
+                                    # the full diagnostic) — a run that keeps
+                                    # blowing through recoveries needs a
+                                    # config change, not infinite retries
     profile_steps: int = 0          # with profile_dir set: stop the jax.profiler
                                     # trace once this many steps complete after
                                     # fit() starts (0 = trace the whole fit, the
@@ -540,6 +615,19 @@ class Word2VecConfig:
                     "— the fused kernel applies sum semantics only; use the "
                     "XLA path or bound the row loads via "
                     "negative_pool/subsample_ratio instead")
+            if self.max_row_norm or self.update_clip or self.row_l2:
+                raise ValueError(
+                    "the in-step stabilizers (max_row_norm/update_clip/"
+                    "row_l2) are not implemented for use_pallas=True — the "
+                    "fused kernel owns its own update math; use the XLA "
+                    "paths, which compile the stabilizers into every "
+                    "lowering (ops/sgns.py)")
+            if self.norm_watch == "recover":
+                raise ValueError(
+                    "norm_watch='recover' auto-engages max_row_norm, which "
+                    "the fused pallas kernel does not implement — use "
+                    "norm_watch='warn'/'halt' with use_pallas=True, or the "
+                    "XLA paths for auto-recovery")
         if (self.cbow and self.duplicate_scaling and self.negative_pool > 0):
             raise ValueError(
                 "CBOW with duplicate_scaling=True implements mean semantics "
@@ -648,10 +736,31 @@ class Word2VecConfig:
         if self.max_rollbacks < 0:
             raise ValueError(
                 f"max_rollbacks must be nonnegative but got {self.max_rollbacks}")
-        if self.norm_watch not in ("off", "warn", "halt"):
+        if self.norm_watch not in ("off", "warn", "recover", "halt"):
             raise ValueError(
-                f"norm_watch must be 'off', 'warn', or 'halt' "
+                f"norm_watch must be 'off', 'warn', 'recover', or 'halt' "
                 f"but got {self.norm_watch!r}")
+        if self.max_row_norm < 0:
+            raise ValueError(
+                f"max_row_norm must be nonnegative (0 = off) "
+                f"but got {self.max_row_norm}")
+        if self.update_clip < 0:
+            raise ValueError(
+                f"update_clip must be nonnegative (0 = off) "
+                f"but got {self.update_clip}")
+        if not (0 <= self.row_l2 < 1):
+            # (1 − alpha·row_l2) must stay a contraction for any alpha <= 1;
+            # realistic decay sits orders of magnitude below 1 anyway
+            raise ValueError(
+                f"row_l2 must be in [0, 1) (0 = off) but got {self.row_l2}")
+        if not (0 < self.recover_lr_backoff <= 1):
+            raise ValueError(
+                f"recover_lr_backoff must be in (0, 1] "
+                f"but got {self.recover_lr_backoff}")
+        if self.max_recoveries < 0:
+            raise ValueError(
+                f"max_recoveries must be nonnegative "
+                f"but got {self.max_recoveries}")
         if self.norm_watch_threshold <= 0:
             raise ValueError(
                 f"norm_watch_threshold must be positive "
@@ -704,6 +813,12 @@ class Word2VecConfig:
             # a trained model's metadata must pin the semantics it trained with,
             # and format-version-1 readers reject a -1.0 sentinel
             d["subsample_ratio"] = -1.0
+        if auto_markers and getattr(self, "_auto_pool", False):
+            # same rule for the pool: a round-tripped AUTO pool must stay AUTO
+            # so the Trainer's vocab-scaled re-resolution (load <= 160 past
+            # 500k vocab) still applies on the receiving side — a frozen
+            # resolved value would read as explicit and skip the safety rule
+            d["negative_pool"] = -1
         return d
 
     @classmethod
@@ -713,7 +828,10 @@ class Word2VecConfig:
         if "mesh_shape" in clean and clean["mesh_shape"] is not None:
             clean["mesh_shape"] = tuple(clean["mesh_shape"])
         if (clean.get("cbow") and clean.get("duplicate_scaling")
-                and clean.get("negative_pool", 0)
+                # > 0: only RESOLVED stored pools need the normalization; a
+                # -1 AUTO marker (to_dict round-trip) resolves itself to 0
+                # beside cbow+duplicate_scaling and must stay AUTO
+                and clean.get("negative_pool", 0) > 0
                 and clean.get("cbow_update", "scatter") == "scatter"):
             # pre-selection-matrix checkpoints stored a resolved auto pool next
             # to cbow+duplicate_scaling; the old trainer IGNORED that pool

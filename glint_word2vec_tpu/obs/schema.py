@@ -51,9 +51,26 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     },
     "watchdog": {
         "step": (int,),
-        "policy": (str,),        # "warn" | "halt"
+        "policy": (str,),        # "warn" | "recover" | "halt"
         "reason": (str,),
         "channels": (dict,),     # the probe channels the decision was made on
+    },
+    # one per norm_watch="recover" ladder action (ADDITIVE under the schema
+    # evolution rule: a brand-new kind; no existing field moved). Emitted
+    # BEFORE the rollback mutates any state, so even a crash mid-recovery
+    # leaves the evidence in the run log — and the budget-exhaustion record
+    # (action="halt") lands before the NormBlowupError raise, the same
+    # record-before-raise contract as the watchdog-halt path.
+    "recovery": {
+        "step": (int,),          # global step the firing probe observed
+        "action": (str,),        # "rollback" | "halt" (budget exhausted)
+        "reason": (str,),        # the watchdog firing reason
+        "snapshot_step": (int,), # restore point (-1 when action="halt")
+        "recoveries_performed": (int,),  # AFTER this action
+        "max_recoveries": (int,),
+        "lr_scale": _NUM,        # effective lr multiplier AFTER this action
+        "max_row_norm": _NUM,    # engaged clamp AFTER this action (0 = off)
+        "channels": (dict,),
     },
     "run_end": {
         "run_id": (str,),

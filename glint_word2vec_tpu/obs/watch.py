@@ -18,7 +18,11 @@ measured signatures, per matrix:
 
 Policy (``config.norm_watch``): ``warn`` logs + emits a telemetry record per
 firing probe (training continues — the research posture while the ROADMAP
-item 2 ladder correlates norm trajectory with quality); ``halt`` raises
+item 2 ladder correlates norm trajectory with quality); ``recover`` returns
+the firing reason to the trainer, which runs the detect→mitigate→recover
+ladder (snapshot-ring rollback + lr backoff + ``max_row_norm`` engagement
+under a ``max_recoveries`` budget — trainer._watchdog_check,
+docs/robustness.md); ``halt`` raises
 :class:`~glint_word2vec_tpu.train.faults.NormBlowupError` with the channels
 and the measured mitigations, the same fail-fast contract as
 ``nonfinite_policy="halt"``. Thresholds and their provenance:
@@ -40,9 +44,9 @@ class NormWatchdog:
 
     def __init__(self, policy: str, threshold: float, max_norm: float,
                  frac: float):
-        if policy not in ("off", "warn", "halt"):
-            raise ValueError(f"norm_watch policy must be 'off', 'warn', or "
-                             f"'halt' but got {policy!r}")
+        if policy not in ("off", "warn", "recover", "halt"):
+            raise ValueError(f"norm_watch policy must be 'off', 'warn', "
+                             f"'recover', or 'halt' but got {policy!r}")
         self.policy = policy
         self.threshold = threshold
         self.max_norm = max_norm
@@ -50,11 +54,12 @@ class NormWatchdog:
         self.fires = 0
         self.last_reason: Optional[str] = None
 
-    def check(self, channels: dict, step: int) -> Optional[str]:
-        """Evaluate one probe result. Returns the firing reason (also stored
-        on :attr:`last_reason`) or None; raises under ``policy="halt"``."""
-        if self.policy == "off":
-            return None
+    def would_fire(self, channels: dict) -> Optional[str]:
+        """Pure threshold evaluation: the firing reason for one probe channel
+        dict, or None. No state is touched and no policy applies — the
+        trainer also consults this to keep a state the watchdog would flag
+        OUT of the snapshot ring (a blown carry must never become the
+        'good' restore point)."""
         reasons = []
         for name in ("syn0", "syn1"):
             ch = channels.get(name) or {}
@@ -67,10 +72,20 @@ class NormWatchdog:
             if mx >= self.max_norm:
                 reasons.append(
                     f"{name}: max row norm {mx:.3g} >= {self.max_norm:g}")
-        if not reasons:
+        return "; ".join(reasons) if reasons else None
+
+    def check(self, channels: dict, step: int) -> Optional[str]:
+        """Evaluate one probe result. Returns the firing reason (also stored
+        on :attr:`last_reason`) or None; raises under ``policy="halt"``.
+        Under ``"recover"`` the reason is returned for the trainer to act on
+        (rollback/backoff/clamp-engage — the ladder lives in the trainer,
+        which owns the snapshot ring and the step functions)."""
+        if self.policy == "off":
+            return None
+        reason = self.would_fire(channels)
+        if reason is None:
             return None
         self.fires += 1
-        reason = "; ".join(reasons)
         self.last_reason = reason
         diag = (
             f"finite norm blowup at global step {step}: {reason}. This is "
@@ -82,6 +97,13 @@ class NormWatchdog:
             f"duplicate_scaling=True")
         if self.policy == "halt":
             raise NormBlowupError(diag)
+        if self.policy == "recover":
+            # one line per firing — the trainer logs the recovery action
+            # itself (snapshot step, lr scale, engaged clamp) right after
+            logger.warning(
+                "norm watchdog (firing %d) at step %d: %s — recovering",
+                self.fires, step, reason)
+            return reason
         if self.fires == 1:
             logger.warning("norm watchdog: %s", diag)
         else:

@@ -44,7 +44,7 @@ trainer._build_step).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -52,8 +52,12 @@ import jax.numpy as jnp
 from glint_word2vec_tpu.ops.sgns import (
     EmbeddingPair,
     StepMetrics,
+    Stabilizers,
     _log_sigmoid,
+    _mask_sentinel,
     _sigmoid,
+    clip_update_rows,
+    stabilize_rows,
 )
 
 # above this window the unrolled shifted-add endpoint accumulation (2·window
@@ -137,6 +141,7 @@ def cbow_step_banded_core(
     compute_dtype: jnp.dtype = jnp.float32,
     logits_dtype: jnp.dtype = jnp.float32,
     with_metrics: bool = True,
+    stabilizers: Optional[Stabilizers] = None,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """Banded CBOW update — mathematically the shared-pool scatter step
     (:func:`~glint_word2vec_tpu.ops.sgns.cbow_step_shared_core`) on the example
@@ -190,6 +195,13 @@ def cbow_step_banded_core(
     d_hidden = gp * e_out + gn @ Z                                  # [T, D]
     d_out = gp * hidden
     d_Z = gn.T @ hidden                                             # [P, D]
+    if stabilizers is not None and stabilizers.update_clip:
+        # clip BEFORE the mean-convention split/spread — the same quantity
+        # the scatter formulation clips (ops/sgns.py), so the two CBOW
+        # formulations stay equivalent with stabilizers on. d_Z never clips
+        # (Stabilizers docstring).
+        d_hidden = clip_update_rows(d_hidden, stabilizers.update_clip)
+        d_out = clip_update_rows(d_out, stabilizers.update_clip)
 
     # -- backward: banded spread of d_hidden/n via difference array + prefix --
     g_row = d_hidden.astype(pf) / ctx_n[:, None]                    # [T, D]
@@ -200,6 +212,21 @@ def cbow_step_banded_core(
     new_syn0 = syn0.at[tokens].add(d_ctx.astype(dtype))
     new_syn1 = syn1.at[tokens].add(d_out.astype(dtype))
     new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
+    if stabilizers is not None and stabilizers.post_pass:
+        # touched sets of THIS formulation: syn0 at every valid token slot
+        # (each is a potential context row of the band — a context-less token
+        # sees a zero update but is still in the scatter's index list, so it
+        # clamps/decays here where the scatter formulation would skip it: the
+        # one documented touched-set difference between the formulations),
+        # syn1 at the live centers plus the whole shared pool
+        V = syn0.shape[0]
+        enable = (token_mask.sum() > 0).astype(jnp.float32)
+        new_syn0 = stabilize_rows(
+            new_syn0, _mask_sentinel(tokens, token_mask, V), alpha,
+            stabilizers, enable)
+        idx1 = jnp.concatenate(
+            [_mask_sentinel(tokens, live, V), negatives])
+        new_syn1 = stabilize_rows(new_syn1, idx1, alpha, stabilizers, enable)
 
     if with_metrics:
         denom = jnp.maximum(live.sum(), 1.0)

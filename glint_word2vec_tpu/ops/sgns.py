@@ -28,7 +28,7 @@ unlike the reference's accepted Hogwild races (README.md:17-19).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,113 @@ import jax.numpy as jnp
 from glint_word2vec_tpu.ops.sampler import AliasTable, sample_negatives
 
 MAX_EXP = 6.0  # the reference's LUT clipping range (mllib:247, EXP_TABLE_SIZE/MAX_EXP)
+
+# divide guard for the stabilizer norm ratios — far below any row norm a
+# trained embedding can reach in f32 (min normal ~1.2e-38) yet nonzero, so a
+# zero row clamps with scale min(1, max/eps) = 1 instead of NaN
+_STAB_EPS = 1e-30
+
+
+class Stabilizers(NamedTuple):
+    """In-step numeric stabilizers (config.max_row_norm / update_clip /
+    row_l2 — docs/robustness.md escalation ladder). All 0.0 = OFF, and an
+    off knob elides its ops from the compiled step entirely, so the
+    stabilizers-off step is bit-identical to the pre-stabilizer step (tested).
+
+    - ``max_row_norm``: per-TOUCHED-row L2 clamp applied after the scatter
+      update — never a dense [V, D] renorm pass. The direct counter to the
+      measured finite norm blowup (EVAL.md round-5: hot rows run orders of
+      magnitude past the healthy 1-15 band while isfinite stays true).
+    - ``update_clip``: per-row L2 ceiling on each pair's/example's update
+      contribution (the d_in/d_pos rows of SGNS, d_hidden/d_out of CBOW),
+      applied BEFORE the scatter-add. Pool-row deltas (d_Z) are deliberately
+      NOT clipped: under shard_map each data shard holds only a partial d_Z
+      sum, so clipping there would diverge from the single-program lowering —
+      pool rows are bounded by the n/P reweight plus ``max_row_norm`` instead.
+    - ``row_l2``: L2 weight decay on touched rows — each touched row scales
+      by (1 − α·row_l2) once per step regardless of in-batch multiplicity.
+
+    All norm/scale math runs in float32 regardless of param/compute dtype
+    (the R4 accumulation discipline: bf16 squared norms underflow exactly
+    where the blowup channel saturates).
+    """
+
+    max_row_norm: float = 0.0
+    update_clip: float = 0.0
+    row_l2: float = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.max_row_norm or self.update_clip or self.row_l2)
+
+    @property
+    def post_pass(self) -> bool:
+        """Whether the post-scatter touched-row pass (clamp/decay) runs."""
+        return bool(self.max_row_norm or self.row_l2)
+
+
+def clip_update_rows(d: jax.Array, clip: float) -> jax.Array:
+    """Per-row L2 ceiling on an update-row block ``[..., D]``: rows whose L2
+    norm exceeds ``clip`` rescale to exactly ``clip``; shorter rows pass
+    through bit-exact (scale 1.0 round-trips the dtype). Norm math in
+    ``promote_types(d.dtype, float32)`` — never below f32 (R4), never below
+    the data's own precision (the f64 oracle suite holds this path exact)."""
+    if not clip:
+        return d
+    pf = jnp.promote_types(d.dtype, jnp.float32)
+    dp = d.astype(pf)
+    n2 = jnp.sum(dp * dp, axis=-1, keepdims=True)
+    scale = jnp.minimum(
+        jnp.asarray(1.0, pf),
+        jnp.asarray(clip, pf) / jnp.maximum(jnp.sqrt(n2),
+                                            jnp.asarray(_STAB_EPS, pf)))
+    return (dp * scale).astype(d.dtype)
+
+
+def stabilize_rows(
+    mat: jax.Array,       # [Vs, D] — a just-updated param matrix (or shard)
+    idx: jax.Array,       # int32 [N] — touched rows; >= Vs = drop sentinel
+    alpha: jax.Array,     # scalar learning rate (already decayed)
+    stab: Stabilizers,
+    enable: jax.Array,    # f32 scalar 1.0/0.0 — 0 on all-masked padded batches
+) -> jax.Array:
+    """Post-scatter touched-row stabilizer pass: gather the just-updated rows
+    at ``idx``, apply the touched-row weight decay ``(1 − α·row_l2)`` then the
+    ``max_row_norm`` clamp (clamping the DECAYED norm), and write the rows
+    back with one scatter-set. Duplicate indices are safe by construction:
+    every duplicate computes the identical replacement value (same gathered
+    row → same scale), so the unordered scatter writes agree. Indices at or
+    past ``mat.shape[0]`` (the caller's mask/ownership sentinel) drop — vocab
+    padding rows are never touched. ``enable=0`` pins every scale to 1.0, so
+    a fully-masked padded batch stays a bit-level no-op."""
+    if not stab.post_pass:
+        return mat
+    vs = mat.shape[0]
+    # norm/scale math in promote_types(dtype, f32): never below f32 (bf16
+    # squared norms underflow exactly where the blowup saturates — R4),
+    # never below the data's own precision (f64 oracle exactness)
+    pf = jnp.promote_types(mat.dtype, jnp.float32)
+    rows = mat[jnp.minimum(idx, vs - 1)].astype(pf)
+    scale = jnp.ones(rows.shape[:-1], pf)
+    if stab.row_l2:
+        scale = scale * (jnp.asarray(1.0, pf)
+                         - alpha.astype(pf) * jnp.asarray(stab.row_l2, pf))
+    if stab.max_row_norm:
+        norm = jnp.sqrt(jnp.sum(rows * rows, axis=-1)) * scale
+        scale = scale * jnp.minimum(
+            jnp.asarray(1.0, pf),
+            jnp.asarray(stab.max_row_norm, pf)
+            / jnp.maximum(norm, jnp.asarray(_STAB_EPS, pf)))
+    scale = jnp.where(enable > 0, scale, jnp.asarray(1.0, pf))
+    return mat.at[idx].set(
+        (rows * scale[..., None]).astype(mat.dtype), mode="drop")
+
+
+def _mask_sentinel(idx: jax.Array, gate: jax.Array, vs: int) -> jax.Array:
+    """Touched-index list with gated-off slots mapped to the drop sentinel
+    ``vs`` (one past the last row): a masked batch slot's placeholder index
+    (0) must not drag a real row into the clamp/decay pass."""
+    return jnp.where(gate > 0, idx, jnp.int32(vs))
 
 
 class EmbeddingPair(NamedTuple):
@@ -150,10 +257,17 @@ def sgns_step_core(
     sigmoid_mode: str = "exact",
     compute_dtype: jnp.dtype = jnp.float32,
     duplicate_scaling: bool = False,
+    stabilizers: Optional[Stabilizers] = None,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """:func:`sgns_step` with the negatives supplied by the caller — the form the
     trainer jits (sampling happens once per dispatch chunk, outside the scan, because
-    in-program threefry is catastrophically slow on TPU; see ops/prng.py)."""
+    in-program threefry is catastrophically slow on TPU; see ops/prng.py).
+
+    ``stabilizers`` (None/all-zero = off, bit-identical step): ``update_clip``
+    caps every per-pair update row (d_in, d_pos, and — per-pair negatives
+    being per-pair rows — d_neg); the post-scatter pass clamps/decays the
+    touched rows: syn0 at the unmasked centers, syn1 at the unmasked contexts
+    plus the negatives of unmasked pairs (see :class:`Stabilizers`)."""
     syn0, syn1 = params
     V = syn0.shape[0]
     neg_valid = (negatives != contexts[:, None]).astype(jnp.float32) * mask[:, None]
@@ -186,6 +300,10 @@ def sgns_step_core(
             + jnp.einsum("bn,bnd->bd", g_neg_in.astype(compute_dtype), e_neg))
     d_pos = g_pos_out[:, None].astype(compute_dtype) * e_in          # [B, D]
     d_neg = g_neg_out[..., None].astype(compute_dtype) * e_in[:, None, :]  # [B, n, D]
+    if stabilizers is not None and stabilizers.update_clip:
+        d_in = clip_update_rows(d_in, stabilizers.update_clip)
+        d_pos = clip_update_rows(d_pos, stabilizers.update_clip)
+        d_neg = clip_update_rows(d_neg, stabilizers.update_clip)
 
     dtype = syn0.dtype
     new_syn0 = syn0.at[centers].add(d_in.astype(dtype))
@@ -193,6 +311,17 @@ def sgns_step_core(
     D = syn1.shape[1]
     new_syn1 = new_syn1.at[negatives.reshape(-1)].add(
         d_neg.reshape(-1, D).astype(dtype))
+    if stabilizers is not None and stabilizers.post_pass:
+        enable = (mask.sum() > 0).astype(jnp.float32)
+        new_syn0 = stabilize_rows(
+            new_syn0, _mask_sentinel(centers, mask, V), alpha,
+            stabilizers, enable)
+        idx1 = jnp.concatenate([
+            _mask_sentinel(contexts, mask, V),
+            _mask_sentinel(negatives,
+                           jnp.broadcast_to(mask[:, None], negatives.shape),
+                           V).reshape(-1)])
+        new_syn1 = stabilize_rows(new_syn1, idx1, alpha, stabilizers, enable)
 
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = (-_log_sigmoid(f_pos) * mask
@@ -299,9 +428,19 @@ def sgns_step_shared_core(
     duplicate_scaling: bool = False,
     logits_dtype: jnp.dtype = jnp.float32,
     with_metrics: bool = True,
+    stabilizers: Optional[Stabilizers] = None,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """:func:`sgns_step_shared` with the pool supplied by the caller (see
     :func:`sgns_step_core` for why sampling lives outside the jitted scan).
+
+    ``stabilizers`` (None/all-zero = off, bit-identical step): ``update_clip``
+    caps the per-pair d_in/d_pos rows (NOT the pool deltas d_Z — see
+    :class:`Stabilizers` for the shard_map-parity rationale); the post-scatter
+    pass clamps/decays the touched rows — syn0 at the unmasked centers, syn1
+    at the unmasked contexts plus the whole shared pool (every pool row is
+    part of the step's touched set by construction). The explicit shard_map
+    lowering (ops/sgns_shard.py) applies the identical math owner-locally, so
+    the two lowerings agree to the usual f32-reassociation tolerance.
 
     ``duplicate_scaling`` extends :func:`sgns_step_core`'s mean-update semantics to
     this path: each embedding row moves by the MEAN of its per-pair updates instead of
@@ -362,11 +501,22 @@ def sgns_step_shared_core(
     d_Z = gn.T @ e_in                                    # [P, D] — MXU
     if z_scale is not None:
         d_Z = d_Z * z_scale[:, None].astype(compute_dtype)
+    if stabilizers is not None and stabilizers.update_clip:
+        d_in = clip_update_rows(d_in, stabilizers.update_clip)
+        d_pos = clip_update_rows(d_pos, stabilizers.update_clip)
 
     dtype = syn0.dtype
     new_syn0 = syn0.at[centers].add(d_in.astype(dtype))
     new_syn1 = syn1.at[contexts].add(d_pos.astype(dtype))
     new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
+    if stabilizers is not None and stabilizers.post_pass:
+        enable = (mask.sum() > 0).astype(jnp.float32)
+        new_syn0 = stabilize_rows(
+            new_syn0, _mask_sentinel(centers, mask, V), alpha,
+            stabilizers, enable)
+        idx1 = jnp.concatenate(
+            [_mask_sentinel(contexts, mask, V), negatives])
+        new_syn1 = stabilize_rows(new_syn1, idx1, alpha, stabilizers, enable)
 
     if with_metrics:
         denom = jnp.maximum(mask.sum(), 1.0)
@@ -420,9 +570,16 @@ def cbow_step_core(
     sigmoid_mode: str = "exact",
     compute_dtype: jnp.dtype = jnp.float32,
     duplicate_scaling: bool = False,
+    stabilizers: Optional[Stabilizers] = None,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """:func:`cbow_step` with the negatives supplied by the caller (see
-    :func:`sgns_step_core` for why sampling lives outside the jitted scan)."""
+    :func:`sgns_step_core` for why sampling lives outside the jitted scan).
+
+    ``stabilizers``: ``update_clip`` caps the per-example d_hidden (before the
+    mean-convention split into per-context rows — so the banded formulation
+    applies the identical clipped quantity), d_out, and per-example d_neg
+    rows; the post pass clamps/decays syn0 at the live context slots and syn1
+    at the live centers plus the negatives of unmasked examples."""
     syn0, syn1 = params
     B, C = contexts.shape
     neg_valid = (negatives != centers[:, None]).astype(jnp.float32) * mask[:, None]
@@ -458,16 +615,32 @@ def cbow_step_core(
 
     gp = g_pos[:, None].astype(compute_dtype)
     d_hidden = gp * e_out + jnp.einsum("bn,bnd->bd", g_neg.astype(compute_dtype), e_neg)
-    # mean convention: each context word gets d_hidden / |context|
-    d_ctx = (d_hidden / ctx_n[:, None])[:, None, :] * ctx_m * ctx_scale[..., None]
     d_out = g_pos_out[:, None].astype(compute_dtype) * hidden
     d_neg = g_neg_out[..., None].astype(compute_dtype) * hidden[:, None, :]
+    if stabilizers is not None and stabilizers.update_clip:
+        d_hidden = clip_update_rows(d_hidden, stabilizers.update_clip)
+        d_out = clip_update_rows(d_out, stabilizers.update_clip)
+        d_neg = clip_update_rows(d_neg, stabilizers.update_clip)
+    # mean convention: each context word gets d_hidden / |context|
+    d_ctx = (d_hidden / ctx_n[:, None])[:, None, :] * ctx_m * ctx_scale[..., None]
 
     dtype = syn0.dtype
     D = syn0.shape[1]
     new_syn0 = syn0.at[contexts.reshape(-1)].add(d_ctx.reshape(-1, D).astype(dtype))
     new_syn1 = syn1.at[centers].add(d_out.astype(dtype))
     new_syn1 = new_syn1.at[negatives.reshape(-1)].add(d_neg.reshape(-1, D).astype(dtype))
+    if stabilizers is not None and stabilizers.post_pass:
+        enable = (mask.sum() > 0).astype(jnp.float32)
+        new_syn0 = stabilize_rows(
+            new_syn0,
+            _mask_sentinel(contexts, live_ctx, V).reshape(-1), alpha,
+            stabilizers, enable)
+        idx1 = jnp.concatenate([
+            _mask_sentinel(centers, mask * has_ctx, V),
+            _mask_sentinel(negatives,
+                           jnp.broadcast_to(mask[:, None], negatives.shape),
+                           V).reshape(-1)])
+        new_syn1 = stabilize_rows(new_syn1, idx1, alpha, stabilizers, enable)
 
     denom = jnp.maximum((mask * has_ctx).sum(), 1.0)
     neg_live = neg_valid * has_ctx[:, None]
@@ -494,6 +667,7 @@ def cbow_step_shared_core(
     compute_dtype: jnp.dtype = jnp.float32,
     logits_dtype: jnp.dtype = jnp.float32,
     with_metrics: bool = True,
+    stabilizers: Optional[Stabilizers] = None,
 ) -> Tuple[EmbeddingPair, StepMetrics]:
     """CBOW with a batch-shared negative pool — the CBOW analog of
     :func:`sgns_step_shared_core` (same estimator: each negative term reweighted by
@@ -501,7 +675,9 @@ def cbow_step_shared_core(
     pool entries equal to an example's center are masked). All negative compute rides
     the MXU: ``f_neg = hidden @ Zᵀ`` and ``dZ = g_negᵀ @ hidden``. ``logits_dtype``
     and ``with_metrics`` as in :func:`sgns_step_shared_core` (the [B, P] chain /
-    the trainer's metrics-elided fast twin)."""
+    the trainer's metrics-elided fast twin). ``stabilizers``: clips d_hidden
+    (pre mean-split, so the banded formulation matches) and d_out, never d_Z;
+    post pass over the live context slots, live centers, and the whole pool."""
     syn0, syn1 = params
     P = negatives.shape[0]
     neg_valid = (negatives[None, :] != centers[:, None]).astype(logits_dtype) \
@@ -527,16 +703,30 @@ def cbow_step_shared_core(
     gp = g_pos[:, None].astype(compute_dtype)
     gn = g_neg.astype(compute_dtype)
     d_hidden = gp * e_out + gn @ Z                                    # [B, D] — MXU
-    # mean convention: each context word gets d_hidden / |context|
-    d_ctx = (d_hidden / ctx_n[:, None])[:, None, :] * ctx_m
     d_out = gp * hidden
     d_Z = gn.T @ hidden                                               # [P, D] — MXU
+    if stabilizers is not None and stabilizers.update_clip:
+        d_hidden = clip_update_rows(d_hidden, stabilizers.update_clip)
+        d_out = clip_update_rows(d_out, stabilizers.update_clip)
+    # mean convention: each context word gets d_hidden / |context|
+    d_ctx = (d_hidden / ctx_n[:, None])[:, None, :] * ctx_m
 
     dtype = syn0.dtype
     D = syn0.shape[1]
     new_syn0 = syn0.at[contexts.reshape(-1)].add(d_ctx.reshape(-1, D).astype(dtype))
     new_syn1 = syn1.at[centers].add(d_out.astype(dtype))
     new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
+    if stabilizers is not None and stabilizers.post_pass:
+        V = syn0.shape[0]
+        enable = (mask.sum() > 0).astype(jnp.float32)
+        live_ctx = ctx_mask * (mask * has_ctx)[:, None]
+        new_syn0 = stabilize_rows(
+            new_syn0,
+            _mask_sentinel(contexts, live_ctx, V).reshape(-1), alpha,
+            stabilizers, enable)
+        idx1 = jnp.concatenate(
+            [_mask_sentinel(centers, mask * has_ctx, V), negatives])
+        new_syn1 = stabilize_rows(new_syn1, idx1, alpha, stabilizers, enable)
 
     if with_metrics:
         denom = jnp.maximum((mask * has_ctx).sum(), 1.0)

@@ -52,7 +52,7 @@ single-device step at every 8-device mesh shape (tests/test_shard_map_step.py).
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,7 +60,8 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from glint_word2vec_tpu.ops.sgns import (
-    EmbeddingPair, StepMetrics, shared_pool_coeffs, shared_pool_loss_terms)
+    EmbeddingPair, StepMetrics, Stabilizers, clip_update_rows,
+    shared_pool_coeffs, shared_pool_loss_terms, stabilize_rows)
 from glint_word2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -96,6 +97,7 @@ def make_shard_map_sgns_step(
     compute_dtype: jnp.dtype = jnp.float32,
     logits_dtype: jnp.dtype = jnp.float32,
     with_metrics: bool = True,
+    stabilizers: Optional[Stabilizers] = None,
 ) -> Callable[..., Tuple[EmbeddingPair, StepMetrics]]:
     """Build the explicitly-scheduled sharded step. The returned function has
     the trainer's ``inner`` signature — ``(params, batch, negatives, alpha) ->
@@ -155,6 +157,12 @@ def make_shard_map_sgns_step(
         d_in = g_pos[:, None].astype(compute_dtype) * e_pos + gn @ Z
         d_pos = g_pos[:, None].astype(compute_dtype) * e_in
         d_Z = gn.T @ e_in                            # [P, D] partial over Bl pairs
+        if stabilizers is not None and stabilizers.update_clip:
+            # the per-pair rows only, never the (shard-partial) d_Z — the
+            # exact scoping the single-program lowering applies (ops/sgns.py
+            # Stabilizers docstring), so the lowerings cannot drift
+            d_in = clip_update_rows(d_in, stabilizers.update_clip)
+            d_pos = clip_update_rows(d_pos, stabilizers.update_clip)
 
         # (3) data-axis payload exchange: deltas in param dtype + int32 indices,
         # ONE all_gather each (the index list is 4 bytes/row — noise next to
@@ -179,6 +187,36 @@ def make_shard_map_sgns_step(
         # (4) owner-local scatters — ZERO update bytes cross the model axis
         new_syn0 = _owner_local_scatter_add(syn0, idx0, upd0, row_offset)
         new_syn1 = _owner_local_scatter_add(syn1, idx1, upd1, row_offset)
+
+        # (4b) owner-local touched-row stabilizer pass (config.max_row_norm /
+        # row_l2): the rows layout owns FULL rows per shard, so the clamp's
+        # norm math runs locally on the just-updated block — the same
+        # gathered index lists drive it, with masked batch slots mapped to a
+        # global OOB sentinel (their placeholder index 0 must not drag row 0
+        # into the pass) and non-owned/sentinel rows dropping at the scatter-
+        # set exactly like the update scatter. One extra [B]-float all_gather
+        # of the mask funds the gating — only compiled in when a stabilizer
+        # is ON, so the stabilizers-off program is untouched.
+        if stabilizers is not None and stabilizers.post_pass:
+            gmask = mask
+            if nd > 1:
+                gmask = jax.lax.all_gather(gmask, DATA_AXIS, tiled=True)
+            enable = (gmask.sum() > 0).astype(jnp.float32)
+            sent = jnp.int32(vs * nm)                # global OOB sentinel
+            stab0 = jnp.where(gmask > 0, idx0, sent)  # [nd·bl] centers
+            gm = gmask.reshape(nd, bl)
+            m1 = jnp.concatenate(
+                [gm, jnp.ones((nd, pool), jnp.float32)], axis=1).reshape(-1)
+            stab1 = jnp.where(m1 > 0, idx1, sent)
+
+            def loc(i):
+                li = i - row_offset
+                return jnp.where((li >= 0) & (li < vs), li, vs)
+
+            new_syn0 = stabilize_rows(
+                new_syn0, loc(stab0), alpha, stabilizers, enable)
+            new_syn1 = stabilize_rows(
+                new_syn1, loc(stab1), alpha, stabilizers, enable)
 
         # metrics: three scalars psum'd over `data` (loss/mean_f_pos follow
         # the GSPMD step's masked-mean: global numerators / global pair count)

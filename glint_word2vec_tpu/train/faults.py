@@ -30,11 +30,13 @@ tests; all off by default and zero-cost when off):
   at the first round whose global step reaches N (once), exercising the
   non-finite guardrail's halt/rollback policies.
 - ``GLINT_FAULT_SCALE_PARAMS_AT_STEP=N`` (with optional
-  ``GLINT_FAULT_SCALE_PARAMS_FACTOR``, default 1e6) — the trainer multiplies
-  the whole params carry once at the first round reaching step N: a FINITE
+  ``GLINT_FAULT_SCALE_PARAMS_FACTOR``, default 1e6, and
+  ``GLINT_FAULT_SCALE_PARAMS_TIMES``, default 1) — the trainer multiplies
+  the whole params carry at the first round reaching step N (and, with
+  TIMES > 1, each qualifying round after until the count is spent): a FINITE
   norm blowup, the measured large-vocab collapse signature the non-finite
-  guardrail cannot see — exercising the norm watchdog
-  (``config.norm_watch``, obs/watch.py).
+  guardrail cannot see — exercising the norm watchdog and its recovery
+  ladder (``config.norm_watch``, obs/watch.py, trainer._watchdog_check).
 
 SIGKILL (not ``sys.exit``) is deliberate: no ``finally`` blocks, no atexit, no
 flushes — the same failure surface as an OOM-kill or preemption.
@@ -85,6 +87,14 @@ class FaultPlan:
                                    # state the nan_at_step injection cannot
                                    # produce (isfinite stays True throughout)
     scale_params_factor: float = 1e6
+    scale_params_times: int = 1    # how many rounds the scale injection
+                                   # fires (each subsequent qualifying round
+                                   # re-fires until the count is spent) — a
+                                   # repeatedly-reblowing run, the schedule
+                                   # the norm_watch="recover" budget-
+                                   # exhaustion chaos phase needs: every
+                                   # recovery restores a good snapshot and
+                                   # the next firing blows it up again
 
 
 _override: Optional[FaultPlan] = None
@@ -140,6 +150,8 @@ def active_plan() -> FaultPlan:
         scale_params_at_step=_env_int("GLINT_FAULT_SCALE_PARAMS_AT_STEP"),
         scale_params_factor=_env_float(
             "GLINT_FAULT_SCALE_PARAMS_FACTOR", 1e6),
+        scale_params_times=max(
+            _env_int("GLINT_FAULT_SCALE_PARAMS_TIMES"), 1),
     )
 
 
@@ -197,21 +209,26 @@ def take_nan_injection(global_step: int) -> bool:
 
 
 def take_scale_injection(global_step: int) -> float:
-    """Trainer hook: the scripted scale factor exactly once, at the first
-    round whose global step reaches ``scale_params_at_step``; 0.0 otherwise.
-    The deterministic FINITE-blowup twin of :func:`take_nan_injection` —
-    scaled params stay finite, so the non-finite guardrail must stay silent
-    while the norm watchdog (obs/watch.py) fires."""
+    """Trainer hook: the scripted scale factor at the first round whose
+    global step reaches ``scale_params_at_step`` — and, with
+    ``scale_params_times > 1``, at each subsequent qualifying round until the
+    count is spent (the repeated-reblowup schedule the recovery-budget chaos
+    phase drives); 0.0 otherwise. The deterministic FINITE-blowup twin of
+    :func:`take_nan_injection` — scaled params stay finite, so the non-finite
+    guardrail must stay silent while the norm watchdog (obs/watch.py)
+    fires."""
     p = active_plan()
     if not p.scale_params_at_step or global_step < p.scale_params_at_step:
         return 0.0
-    if _counters.get("scale_done"):
+    done = _counters.get("scale_done", 0)
+    if done >= max(p.scale_params_times, 1):
         return 0.0
-    _counters["scale_done"] = True
+    _counters["scale_done"] = done + 1
     logger.warning(
         "injecting finite param blowup (x%g) at global step %d (scripted "
-        "scale_params_at_step=%d)", p.scale_params_factor, global_step,
-        p.scale_params_at_step)
+        "scale_params_at_step=%d, firing %d/%d)", p.scale_params_factor,
+        global_step, p.scale_params_at_step, done + 1,
+        max(p.scale_params_times, 1))
     return float(p.scale_params_factor)
 
 

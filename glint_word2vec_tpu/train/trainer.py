@@ -22,7 +22,7 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass, replace as dc_replace
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +34,7 @@ from glint_word2vec_tpu.data.vocab import Vocabulary
 from glint_word2vec_tpu.ops.sampler import build_alias_table, sample_negatives_hash
 from glint_word2vec_tpu.ops.sgns import (
     EmbeddingPair,
+    Stabilizers,
     StepMetrics,
     alpha_schedule,
     cbow_step_core,
@@ -290,6 +291,12 @@ class Trainer:
     ):
         self.config = config
         self.vocab = vocab
+        # vocab-scaled AUTO pool (EVAL.md round-5): config resolved the pool
+        # without seeing the vocabulary; at > 500k words the measured safe
+        # load band tightens 600 -> 160, so a still-AUTO pool re-resolves
+        # upward here. Must run before anything reads config.negative_pool.
+        self._resolve_vocab_scaled_pool()
+        config = self.config
         if plan is None:
             shape = config.mesh_shape or (config.num_data_shards, config.num_model_shards)
             n_avail = len(jax.devices())
@@ -450,6 +457,21 @@ class Trainer:
         # all built lazily — a policy="none" run pays nothing
         self._snapshot_ring: "deque" = deque(maxlen=config.rollback_history)
         self.rollbacks_performed = 0
+        # stabilization + auto-recovery state (docs/robustness.md escalation
+        # ladder). _stabilizers starts from the config knobs but is TRAINER
+        # state: a norm_watch="recover" firing may engage max_row_norm
+        # mid-run (the step functions are rebuilt then). _lr_scale multiplies
+        # the dispatched alphas (see _stage_dispatch_meta) — recovery backs
+        # it off by config.recover_lr_backoff per firing; it persists across
+        # fit() calls on this trainer (a recovered run's mitigation should
+        # outlive the fit that needed it), while the recovery BUDGET resets
+        # per fit like max_rollbacks.
+        self._stabilizers = Stabilizers(
+            max_row_norm=config.max_row_norm,
+            update_clip=config.update_clip,
+            row_l2=config.row_l2)
+        self._lr_scale = 1.0
+        self.recoveries_performed = 0
         self._health_fn: Optional[Callable] = None  # fused probe (obs/probe.py)
         self._copy_params_fn: Optional[Callable] = None
         self._poison_fn: Optional[Callable] = None  # scripted NaN injection
@@ -614,7 +636,10 @@ class Trainer:
                 "long runs measured a finite norm blowup in this region "
                 "(EVAL.md round-5 ladder — purity collapse without NaN at load "
                 "640; load 160 fixed that collapse and tames norm growth on "
-                "longer runs); consider negative_pool >= %d",
+                "longer runs); consider negative_pool >= %d (an AUTO pool "
+                "scales itself to load <= 160 past 500k vocab — this one was "
+                "set explicitly), or the stabilizer/watchdog knobs "
+                "(max_row_norm, norm_watch='recover' — docs/robustness.md)",
                 pool_load, self.vocab.size,
                 128 * (-(-cfg.pairs_per_batch * cfg.negatives // (160 * 128))))
         elif pool_load > 2000:
@@ -722,6 +747,50 @@ class Trainer:
             lo, cfg.pairs_per_batch, load, self._DUP_LOAD_REFUSE)
         self.config = cfg.replace(subsample_ratio=lo)
 
+    # Vocab-scaled AUTO pool rule, provenance EVAL.md round-5 ladder: the
+    # config-time load <= 600 auto-rule was calibrated at 90k vocab, where
+    # every pool row re-serves (and is re-corrected) thousands of times per
+    # run. At 1.6M vocab a word serves in the pool only ~2x per run, so each
+    # service's load-sized summed update is never re-corrected — measured
+    # FINITE norm blowup (purity 0.99 -> 0.14, NO NaN) at load 640 over 120M
+    # words; load 160 (pool 2048) fixed that collapse at the same lr and
+    # tamed norm growth ~8x at 240M words. The boundary between the regimes
+    # is taken at 500k (the construction-time advisory's threshold since
+    # round 5); between 90k and 500k no collapse was ever measured at load
+    # <= 600.
+    _LARGE_VOCAB_BOUNDARY = 500_000
+    _LARGE_VOCAB_SAFE_LOAD = 160.0
+
+    def _resolve_vocab_scaled_pool(self) -> None:
+        """Re-resolve a still-AUTO shared pool for the vocabulary the config
+        never saw: once vocab.size > 500k, grow the pool until the load
+        B·n/P sits inside the measured large-vocab safe band (<= 160,
+        provenance above), rounded up to the 128-lane MXU tile. Explicit
+        pools are NEVER changed — `_stability_warnings` names the danger
+        instead — and auto-ness is preserved on the replaced config, so
+        ``replace()``/``from_dict`` re-resolution semantics are intact (a
+        later geometry change re-derives the pool from -1 as before)."""
+        cfg = self.config
+        if not getattr(cfg, "_auto_pool", False) or cfg.negative_pool <= 0:
+            return
+        if self.vocab.size <= self._LARGE_VOCAB_BOUNDARY:
+            return
+        load = cfg.pairs_per_batch * cfg.negatives / cfg.negative_pool
+        if load <= self._LARGE_VOCAB_SAFE_LOAD:
+            return
+        p_min = -(-cfg.pairs_per_batch * cfg.negatives
+                  // int(self._LARGE_VOCAB_SAFE_LOAD))
+        pool = max(128, 128 * (-(-p_min // 128)))
+        logger.warning(
+            "auto negative_pool %d -> %d: a %d-word vocabulary puts the "
+            "resolved pool load %.0f inside the measured large-vocab finite-"
+            "blowup region (EVAL.md round-5: collapse at load 640, fixed at "
+            "160); pass negative_pool explicitly to pin a value",
+            cfg.negative_pool, pool, self.vocab.size, load)
+        new_cfg = cfg.replace(negative_pool=pool)
+        new_cfg._auto_pool = True  # still AUTO — geometry changes re-derive
+        self.config = new_cfg
+
     def _build_step(self, with_metrics: bool = True) -> Callable:
         """Build the jitted chunk function. ``with_metrics=False`` builds the
         fast twin of the shared-pool paths (skip-gram and CBOW):
@@ -734,6 +803,12 @@ class Trainer:
         quiet = not with_metrics  # the full build already warned at __init__
         compute_dtype = jnp.dtype(cfg.compute_dtype)
         logits_dtype = jnp.dtype(cfg.logits_dtype)
+        # in-step stabilizers: trainer state, not raw config — a
+        # norm_watch="recover" firing may have engaged max_row_norm since
+        # construction (the rebuild path through _perform_recovery). None
+        # when all off, so the default step compiles bit-identical to the
+        # pre-stabilizer step.
+        stab = self._stabilizers if self._stabilizers.enabled else None
         if not quiet and logits_dtype != jnp.float32 and not (
                 cfg.negative_pool > 0 and not cfg.use_pallas
                 and not (cfg.cbow and cfg.duplicate_scaling)):
@@ -779,6 +854,16 @@ class Trainer:
                     "duplicate_scaling is not implemented for use_pallas=True — the "
                     "fused kernel applies sum semantics only; use the XLA path or "
                     "bound the row loads via negative_pool/subsample_ratio instead")
+            if cfg.max_row_norm or cfg.update_clip or cfg.row_l2:
+                raise ValueError(
+                    "the in-step stabilizers (max_row_norm/update_clip/row_l2) "
+                    "are not implemented for use_pallas=True — the fused "
+                    "kernel owns its own update math; use the XLA paths")
+            if cfg.norm_watch == "recover":
+                raise ValueError(
+                    "norm_watch='recover' auto-engages max_row_norm, which "
+                    "the fused pallas kernel does not implement — use "
+                    "norm_watch='warn'/'halt' or the XLA paths")
             self._stability_warnings()
             if len(plan.mesh.devices.flat) > 1:
                 raise ValueError(
@@ -814,14 +899,15 @@ class Trainer:
                     make_shard_map_sgns_step)
                 inner = make_shard_map_sgns_step(
                     plan.mesh, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
-                    logits_dtype, with_metrics)
+                    logits_dtype, with_metrics, stabilizers=stab)
             else:
                 def inner(params, batch, negatives, alpha):
                     return sgns_step_shared_core(
                         params, batch["centers"], batch["contexts"],
                         batch["mask"], negatives, alpha, cfg.negatives,
                         cfg.sigmoid_mode, compute_dtype,
-                        cfg.duplicate_scaling, logits_dtype, with_metrics)
+                        cfg.duplicate_scaling, logits_dtype, with_metrics,
+                        stabilizers=stab)
 
             neg_shape = shared_pool_shape
         elif cfg.cbow and cfg.negative_pool > 0 and not cfg.duplicate_scaling:
@@ -832,7 +918,8 @@ class Trainer:
                 return cbow_step_shared_core(
                     params, batch["centers"], batch["contexts"], batch["ctx_mask"],
                     batch["mask"], negatives, alpha, cfg.negatives,
-                    cfg.sigmoid_mode, compute_dtype, logits_dtype, with_metrics)
+                    cfg.sigmoid_mode, compute_dtype, logits_dtype, with_metrics,
+                    stabilizers=stab)
 
             neg_shape = shared_pool_shape
         elif cfg.cbow:
@@ -844,7 +931,8 @@ class Trainer:
                 return cbow_step_core(
                     params, batch["centers"], batch["contexts"], batch["ctx_mask"],
                     batch["mask"], negatives, alpha,
-                    cfg.sigmoid_mode, compute_dtype, cfg.duplicate_scaling)
+                    cfg.sigmoid_mode, compute_dtype, cfg.duplicate_scaling,
+                    stabilizers=stab)
 
             neg_shape = lambda K, B: (K, B, cfg.negatives)  # noqa: E731
         else:
@@ -857,7 +945,7 @@ class Trainer:
                 return sgns_step_core(
                     params, batch["centers"], batch["contexts"], batch["mask"],
                     negatives, alpha, cfg.sigmoid_mode, compute_dtype,
-                    cfg.duplicate_scaling)
+                    cfg.duplicate_scaling, stabilizers=stab)
 
             neg_shape = lambda K, B: (K, B, cfg.negatives)  # noqa: E731
 
@@ -997,6 +1085,7 @@ class Trainer:
         W = cfg.window
         H = self._block_halo
         emb_sharding = self._emb_sharding
+        stab = self._stabilizers if self._stabilizers.enabled else None
 
         win = jax.vmap(
             lambda tk, st, nv, lo, hi, wb: device_cbow_windows(
@@ -1026,7 +1115,8 @@ class Trainer:
                     band.left.reshape(-1), band.right.reshape(-1),
                     band.center.reshape(-1), band.token.reshape(-1),
                     negs, alpha, cfg.negatives, W, cfg.sigmoid_mode,
-                    compute_dtype, logits_dtype, with_metrics)
+                    compute_dtype, logits_dtype, with_metrics,
+                    stabilizers=stab)
                 new_p = jax.lax.with_sharding_constraint(
                     new_p, EmbeddingPair(emb_sharding, emb_sharding))
                 return new_p, (metrics, jnp.int32(0))
@@ -1044,8 +1134,19 @@ class Trainer:
         argument to arrive on device: an implicit numpy→device transfer at
         dispatch time is exactly the silent host-transfer regression the
         auditor exists to catch. Cost: a few hundred replicated bytes per
-        dispatch through the same put_global discipline as the feed arrays."""
-        host = {"meta": np.asarray(meta, np.float32),
+        dispatch through the same put_global discipline as the feed arrays.
+
+        This is also the single owner of the recovery lr backoff: every fit
+        path's alphas ride meta row 0 through here, so one multiplicative
+        ``_lr_scale`` (1.0 until a norm_watch="recover" firing backs it off)
+        covers the host feed, both device feeds, and the sharded paths
+        without touching any producer. Identical on every process — the
+        scale only changes on probe rounds, which are allgather-consistent."""
+        meta = np.asarray(meta, np.float32)
+        if self._lr_scale != 1.0:
+            meta = meta.copy()  # never mutate the producer's array in place
+            meta[0] *= np.float32(self._lr_scale)
+        host = {"meta": meta,
                 "base": np.int32(base_step)}
         for i, b in enumerate(bases):
             host[f"b{i}"] = b
@@ -2196,10 +2297,21 @@ class Trainer:
             self._touch_fn = jax.jit(touch)
         return self._touch_fn(stacked)
 
+    @property
+    def _needs_snapshot_ring(self) -> bool:
+        """Single derived predicate for arming the snapshot ring: ANY
+        consumer — nonfinite rollback or the watchdog recovery ladder —
+        arms it. Pre-round-12 only nonfinite_policy=='rollback' seeded the
+        ring, so every other consumer found it empty on first firing (the
+        previously-dead norm_watch='recover' + nonfinite_policy='halt'
+        combination; regression-tested in tests/test_stabilizers.py)."""
+        return (self.config.nonfinite_policy == "rollback"
+                or self.config.norm_watch == "recover")
+
     def _start_run_bookkeeping(self) -> None:
         self.rollbacks_performed = 0  # max_rollbacks is a per-fit() budget
-        if (self.config.nonfinite_policy == "rollback"
-                and not self._snapshot_ring):
+        self.recoveries_performed = 0  # max_recoveries likewise
+        if self._needs_snapshot_ring and not self._snapshot_ring:
             # seed the ring with the starting params so even a blowup inside
             # the first heartbeat window has a restore point
             self._snapshot_ring.append(
@@ -2241,7 +2353,9 @@ class Trainer:
                     "param_dtype", "compute_dtype", "logits_dtype", "cbow",
                     "step_lowering", "device_pairgen", "nonfinite_policy",
                     "norm_watch", "norm_watch_threshold", "norm_watch_max",
-                    "norm_watch_frac", "heartbeat_every_steps")})
+                    "norm_watch_frac", "heartbeat_every_steps",
+                    "max_row_norm", "update_clip", "row_l2",
+                    "recover_lr_backoff", "max_recoveries")})
 
     def _stop_profiler(self) -> None:
         if getattr(self, "_profiling", False):
@@ -2337,9 +2451,7 @@ class Trainer:
         if channels is None:
             channels = self._health_stats()
         if channels["finite"]:
-            if cfg.nonfinite_policy == "rollback":
-                self._snapshot_ring.append(
-                    (self._copy_params(self.params), self.global_step))
+            self._maybe_snapshot(channels)
             return
         if cfg.nonfinite_policy == "halt":
             raise NonFiniteParamsError(self._nonfinite_diagnostic())
@@ -2360,30 +2472,57 @@ class Trainer:
                 f"giving up after {self.rollbacks_performed} rollbacks — the "
                 f"run keeps diverging; this needs a config change, not "
                 f"retries. " + self._nonfinite_diagnostic())
-        # POP the newest snapshot and restore it directly (no copy needed —
-        # the entry leaves the ring, so the next dispatch is free to donate
-        # its buffers). Popping is what makes the deeper ring entries
-        # reachable: a retry that blows up again before the next finite probe
-        # steps back to the NEXT-older snapshot instead of thrashing on the
-        # same one, and an emptied ring escalates to the halt diagnostic.
-        params, snap_step = self._snapshot_ring.pop()
-        self.params = params
+        snap_step, old_step = self._restore_snapshot()
         self.rollbacks_performed += 1
-        old_step = self.global_step
-        self.global_step = max(self.global_step, snap_step) + \
-            self._ROLLBACK_STEP_JUMP
-        self.state = dc_replace(self.state, global_step=self.global_step)
         logger.warning(
             "non-finite params at step %d: rolled back to the snapshot from "
             "step %d and re-seeded the negative-sample lattice (counter -> %d; "
             "rollback %d/%d)", old_step, snap_step, self.global_step,
             self.rollbacks_performed, self.config.max_rollbacks)
 
-    def _watchdog_check(self, channels: dict) -> None:
+    def _restore_snapshot(self) -> Tuple[int, int]:
+        """POP the newest snapshot-ring entry and restore it directly (no
+        copy — the entry leaves the ring, so the next dispatch is free to
+        donate its buffers), then jump the negative-sample counter lattice
+        far past any step the run will legitimately reach so the retried
+        stretch draws a fresh sample path without rebuilding the jitted step
+        (the seed is a compile-time constant). Popping is what makes the
+        deeper ring entries reachable: a retry that blows up again before
+        the next good probe steps back to the NEXT-older snapshot instead of
+        thrashing on the same one, and an emptied ring escalates to the
+        caller's halt diagnostic. ONE owner for both consumers (non-finite
+        rollback and watchdog recovery) so the reseed invariant cannot
+        drift. Returns (snapshot_step, pre-restore global_step)."""
+        params, snap_step = self._snapshot_ring.pop()
+        self.params = params
+        old_step = self.global_step
+        self.global_step = max(self.global_step, snap_step) + \
+            self._ROLLBACK_STEP_JUMP
+        self.state = dc_replace(self.state, global_step=self.global_step)
+        return int(snap_step), old_step
+
+    def _maybe_snapshot(self, channels: dict) -> None:
+        """Append the current params to the snapshot ring when any consumer
+        needs it (the `_needs_snapshot_ring` predicate) AND the probed state
+        is worth restoring: finite, and — when the watchdog is armed — not a
+        state it would flag (a carry mid-blowup must never become the 'good'
+        restore point the recovery then thrashes back to)."""
+        if not self._needs_snapshot_ring or not channels["finite"]:
+            return
+        if (self.norm_watchdog.policy != "off"
+                and self.norm_watchdog.would_fire(channels)):
+            return
+        self._snapshot_ring.append(
+            (self._copy_params(self.params), self.global_step))
+
+    def _watchdog_check(self, channels: dict) -> bool:
         """Feed one probe result to the finite-blowup watchdog and persist any
         firing to the telemetry sink — for ``halt`` the record is emitted
         BEFORE the raise, so the run log carries the evidence the exception
-        message summarizes."""
+        message summarizes. Under ``norm_watch="recover"`` a firing runs the
+        mitigate-and-recover half of the ladder (:meth:`_perform_recovery`);
+        returns True when that consumed this round (the caller must not
+        snapshot the pre-restore params)."""
         from glint_word2vec_tpu.train.faults import NormBlowupError
         try:
             reason = self.norm_watchdog.check(channels, self.global_step)
@@ -2399,6 +2538,97 @@ class Trainer:
                 "watchdog", step=self.global_step,
                 policy=self.config.norm_watch, reason=reason,
                 channels=channels)
+        if reason and self.config.norm_watch == "recover":
+            self._perform_recovery(reason, channels)
+            return True
+        return False
+
+    def _perform_recovery(self, reason: str, channels: dict) -> None:
+        """The mitigate→recover half of the detect→mitigate→recover ladder
+        (docs/robustness.md), run once per firing probe under
+        ``norm_watch="recover"``:
+
+        1. emit the telemetry ``recovery`` record FIRST — before any state
+           mutates, so even a crash mid-recovery leaves the evidence;
+        2. roll back to the newest snapshot-ring entry (popped, like the
+           nonfinite path — repeated firings step back through older
+           entries) and jump the negative-sample counter lattice so the
+           retried stretch draws a fresh sample path;
+        3. auto-engage mitigation for the resumed run: multiply the
+           effective lr by ``config.recover_lr_backoff`` (compounding), and
+           engage ``max_row_norm`` at ``config.norm_watch_threshold`` if no
+           clamp was configured (the step functions are rebuilt — one
+           recompile per engagement, logged);
+        4. budget: after ``config.max_recoveries`` recoveries in one fit —
+           or with no snapshot left — degrade to the ``halt`` contract
+           (NormBlowupError with the full diagnostic, record emitted before
+           the raise), exactly like the non-finite guardrail's exhaustion
+           path."""
+        from glint_word2vec_tpu.train.faults import NormBlowupError
+        cfg = self.config
+
+        def emit(action: str, snap_step: int, lr_scale: float,
+                 clamp: float) -> None:
+            if self._telemetry is not None:
+                self._telemetry.emit(
+                    "recovery", step=self.global_step, action=action,
+                    reason=reason, snapshot_step=snap_step,
+                    recoveries_performed=self.recoveries_performed
+                    + (1 if action == "rollback" else 0),
+                    max_recoveries=cfg.max_recoveries,
+                    lr_scale=round(lr_scale, 9), max_row_norm=clamp,
+                    channels=channels)
+
+        if self.recoveries_performed >= cfg.max_recoveries:
+            emit("halt", -1, self._lr_scale, self._stabilizers.max_row_norm)
+            raise NormBlowupError(
+                f"recovery budget exhausted after {self.recoveries_performed}"
+                f" recoveries (max_recoveries={cfg.max_recoveries}) — the "
+                f"run keeps re-entering the blowup region under lr_scale="
+                f"{self._lr_scale:g} and max_row_norm="
+                f"{self._stabilizers.max_row_norm:g}; this needs a config "
+                f"change (negative_pool/subsample_ratio/learning_rate — "
+                f"EVAL.md), not more retries. Last firing: {reason}")
+        if not self._snapshot_ring:
+            emit("halt", -1, self._lr_scale, self._stabilizers.max_row_norm)
+            raise NormBlowupError(
+                f"norm_watch='recover' fired with no good snapshot left "
+                f"({self.recoveries_performed} recovery(ies) already "
+                f"consumed the ring) — repeated blowups before any finite "
+                f"healthy probe; this needs a config change, not retries. "
+                f"Last firing: {reason}")
+
+        new_scale = self._lr_scale * cfg.recover_lr_backoff
+        engage_clamp = not self._stabilizers.max_row_norm
+        clamp_after = (cfg.norm_watch_threshold if engage_clamp
+                       else self._stabilizers.max_row_norm)
+        emit("rollback", int(self._snapshot_ring[-1][1]), new_scale,
+             clamp_after)
+
+        snap_step, old_step = self._restore_snapshot()
+        self.recoveries_performed += 1
+        self._lr_scale = new_scale
+        if engage_clamp:
+            # engage the clamp at the watchdog threshold: the boundary the
+            # firing measured health by — rows at/below it are by definition
+            # outside the firing signature (provenance: healthy EVAL rows
+            # sit at norm 1-15, the threshold at 100)
+            self._stabilizers = self._stabilizers._replace(
+                max_row_norm=float(cfg.norm_watch_threshold))
+            self._step_fn = self._build_step()
+            self._step_fn_fast = (
+                self._build_step(with_metrics=False)
+                if (cfg.negative_pool > 0 and not cfg.use_pallas
+                    and not (cfg.cbow and cfg.duplicate_scaling))
+                else self._step_fn)
+        logger.warning(
+            "norm watchdog recovery %d/%d at step %d: rolled back to the "
+            "snapshot from step %d, re-seeded the sample lattice (counter -> "
+            "%d), lr backed off to x%g%s — firing: %s",
+            self.recoveries_performed, cfg.max_recoveries, old_step,
+            snap_step, self.global_step, self._lr_scale,
+            (f", engaged max_row_norm={self._stabilizers.max_row_norm:g}"
+             if engage_clamp else ""), reason)
 
     def _end_run(self, status: str) -> None:
         """Emit the run_end record + export the Chrome trace (idempotent per
@@ -2418,6 +2648,8 @@ class Trainer:
                 dispatch_s_total=round(self.dispatch_time, 3),
                 watchdog_fires=int(self.norm_watchdog.fires),
                 rollbacks=int(self.rollbacks_performed),
+                recoveries=int(self.recoveries_performed),
+                lr_scale=round(float(self._lr_scale), 9),
                 spans=self._tracer.span_summary())
             try:
                 self.export_trace(self.config.telemetry_path + ".trace.json")
@@ -2466,6 +2698,11 @@ class Trainer:
         self._pairs_since_log += real_pairs
         self.pairs_trained += real_pairs
         self.state = dc_replace(state, global_step=self.global_step)
+        # the lr scale THIS round's chunk actually dispatched under — a
+        # recovery below backs _lr_scale off for the NEXT dispatch, and the
+        # heartbeat must not retroactively report the new scale for a chunk
+        # trained at the old one
+        lr_scale_at_dispatch = self._lr_scale
 
         if faults.take_nan_injection(self.global_step):
             if self._poison_fn is None:
@@ -2507,6 +2744,13 @@ class Trainer:
             # the end-of-fit finished save — is probed exactly once, so a
             # blown-up state never overwrites the on-disk good checkpoint)
             self._nonfinite_guard(channels)
+        elif (channels is not None and channels["finite"]
+              and cfg.nonfinite_policy == "none"):
+            # the guard isn't in play (policy "none"), but ring consumers
+            # (norm_watch="recover") still need heartbeat-cadence snapshots;
+            # with a policy set, checkpoint rounds snapshot through the
+            # save-side guard sharing this probe
+            self._maybe_snapshot(channels)
         if channels is not None and channels["finite"]:
             # the finite-blowup watchdog (config.norm_watch, obs/watch.py):
             # only meaningful on a finite carry — a non-finite one is the
@@ -2529,7 +2773,9 @@ class Trainer:
                 (metrics.loss, metrics.mean_f_pos))
             rec = HeartbeatRecord(
                 words=self.state.words_processed,
-                alpha=float(alphas[real - 1]),
+                # the EFFECTIVE lr: recovery backoff multiplies the
+                # dispatched alphas at _stage_dispatch_meta
+                alpha=float(alphas[real - 1]) * lr_scale_at_dispatch,
                 loss=float(loss_k[real - 1]),
                 mean_f_pos=float(fpos_k[real - 1]),
                 pairs_per_sec=pps,

@@ -1,7 +1,10 @@
 """R8 bad config half: no construction-time refusal for the combinations the
 trainer fixture refuses at dispatch. The single-knob negative_pool RANGE
 check must NOT count as coverage for the {cbow, negative_pool} dispatch
-combo — its condition says nothing about the combination."""
+combo — its condition says nothing about the combination. The max_row_norm
+range check likewise must not cover the {use_pallas, max_row_norm}
+stabilizer-knob dispatch refusal (the ISSUE-7 regression class: a NEW knob
+lands with a dispatch-only refusal)."""
 import dataclasses
 
 
@@ -10,6 +13,7 @@ class Word2VecConfig:
     cbow: bool = False
     use_pallas: bool = False
     negative_pool: int = -1
+    max_row_norm: float = 0.0
     vector_size: int = 100
 
     def __post_init__(self) -> None:
@@ -17,3 +21,5 @@ class Word2VecConfig:
             raise ValueError("vector_size must be positive")
         if self.negative_pool < -1:
             raise ValueError("negative_pool must be >= -1")
+        if self.max_row_norm < 0:
+            raise ValueError("max_row_norm must be nonnegative")

@@ -1,6 +1,8 @@
-"""R8 bad trainer half: two dispatch-only refusals — one with no config twin
-at all (cbow x use_pallas), one 'covered' only by a single-knob range check
-(cbow x negative_pool), which is not coverage."""
+"""R8 bad trainer half: three dispatch-only refusals — one with no config
+twin at all (cbow x use_pallas), one 'covered' only by a single-knob range
+check (cbow x negative_pool), which is not coverage, and one on a NEW
+stabilizer knob (use_pallas x max_row_norm) whose range check in config is
+likewise not combination coverage."""
 
 
 class Trainer:
@@ -9,6 +11,8 @@ class Trainer:
         if cfg.use_pallas:
             if cfg.cbow:
                 raise ValueError("use_pallas is SGNS-only")
+            if cfg.max_row_norm:
+                raise ValueError("stabilizers are XLA-path only")
         if cfg.cbow:
             if cfg.negative_pool == 0:
                 raise ValueError("cbow needs the shared pool here")

@@ -9,6 +9,7 @@ class Word2VecConfig:
     cbow: bool = False
     use_pallas: bool = False
     negative_pool: int = -1
+    max_row_norm: float = 0.0
     vector_size: int = 100
 
     def __post_init__(self) -> None:
@@ -16,8 +17,12 @@ class Word2VecConfig:
             raise ValueError("vector_size must be positive")
         if self.negative_pool < -1:
             raise ValueError("negative_pool must be >= -1")
+        if self.max_row_norm < 0:
+            raise ValueError("max_row_norm must be nonnegative")
         if self.use_pallas:
             if self.cbow:
                 raise ValueError("use_pallas is SGNS-only")
+            if self.max_row_norm:
+                raise ValueError("stabilizers are XLA-path only")
         if self.cbow and self.negative_pool == 0:
             raise ValueError("cbow needs the shared pool here")

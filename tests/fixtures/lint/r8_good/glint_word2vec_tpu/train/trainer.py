@@ -7,6 +7,8 @@ class Trainer:
         if cfg.use_pallas:
             if cfg.cbow:
                 raise ValueError("use_pallas is SGNS-only")
+            if cfg.max_row_norm:
+                raise ValueError("stabilizers are XLA-path only")
         if cfg.cbow:
             if cfg.negative_pool == 0:
                 raise ValueError("cbow needs the shared pool here")

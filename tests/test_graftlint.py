@@ -71,6 +71,9 @@ def test_r8_fires_on_bad_pair_and_not_on_good_pair():
     # combo "covered" only by a single-knob RANGE check — not coverage:
     # the rule must not be blinded by config range checks on a member knob
     assert any("cbow" in m and "negative_pool" in m for m in msgs), bad
+    # a NEW stabilizer-class knob with a dispatch-only refusal (ISSUE 7):
+    # the range check on max_row_norm must not count as combo coverage
+    assert any("max_row_norm" in m and "use_pallas" in m for m in msgs), bad
     good = rule.check_repo(os.path.join(FIXTURES, "r8_good"))
     assert not good, good
 

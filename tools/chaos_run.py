@@ -20,7 +20,15 @@ Every fault is deterministic (train/faults.py) — no sleep/kill-timing races:
    FINITE blowup (the measured 1.6M-vocab collapse signature, ROADMAP item 2).
    ``nonfinite_policy`` alone must stay silent, ``norm_watch="warn"`` must
    record firings and finish, ``norm_watch="halt"`` must fail fast.
-5. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
+5. **norm-recover** — the full detect→mitigate→recover ladder
+   (docs/robustness.md): the same finite blowup under
+   ``norm_watch="recover"`` (beside ``nonfinite_policy="halt"`` — the
+   snapshot ring must arm for the watchdog even though nonfinite rollback
+   never does) must roll back, back the lr off, engage the row-norm clamp,
+   and FINISH with finite params and ``recoveries_performed >= 1``; a
+   repeatedly-reblowing run past ``max_recoveries`` must degrade to the
+   halt contract (NormBlowupError).
+6. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
    exponential-backoff wrapper in ``data/`` must absorb them.
 
 Usage::
@@ -210,6 +218,58 @@ def phase_norm_blowup() -> str:
     return "norm_watch='halt' finished instead of raising"
 
 
+def phase_norm_recover() -> str:
+    """Close the loop (ISSUE 7): the injected finite blowup must drive the
+    full warn→recover→resume→finish ladder — watchdog fires, the run rolls
+    back to a ring snapshot, lr backs off, the row-norm clamp engages, and
+    fit() COMPLETES with finite params; and a run that re-blows past its
+    recovery budget must degrade to the fail-fast halt contract."""
+    from glint_word2vec_tpu.train import faults
+    from glint_word2vec_tpu.train.faults import NormBlowupError
+
+    # 1. recover: blowup mid-run -> rollback + mitigation -> finish.
+    #    nonfinite_policy stays 'halt' on purpose: the ring must arm for the
+    #    WATCHDOG consumer (the pre-round-12 arming bug left it empty here).
+    faults.configure(scale_params_at_step=8)
+    try:
+        trainer = _fit(toy_sentences(200, seed=2),
+                       toy_config("halt", norm_watch="recover"))
+    except Exception as e:  # noqa: BLE001 — a recover run must not raise
+        return f"norm_watch='recover' raised instead of recovering: {e}"
+    finally:
+        faults.reset()
+    if trainer.recoveries_performed < 1:
+        return "recover run finished but never recovered (fault missed?)"
+    if trainer.norm_watchdog.fires < 1:
+        return "recover run finished without a watchdog firing"
+    if not np.isfinite(np.asarray(trainer.params.syn0)).all():
+        return "recovered run ended with non-finite params"
+    norms = np.linalg.norm(
+        np.asarray(trainer.params.syn0, np.float64), axis=1)
+    if norms.max() > trainer.config.norm_watch_threshold * 1.001:
+        return (f"recovered run still carries blown rows "
+                f"(max norm {norms.max():.3g}) — mitigation not engaged?")
+    if trainer._lr_scale >= 1.0:
+        return "recovery did not back the learning rate off"
+    if not trainer._stabilizers.max_row_norm:
+        return "recovery did not engage max_row_norm"
+
+    # 2. budget exhaustion: the blowup re-fires every round (times=99), so
+    #    after max_recoveries the ladder must degrade to halt, fail-fast
+    faults.configure(scale_params_at_step=8, scale_params_times=99)
+    try:
+        _fit(toy_sentences(200, seed=2),
+             toy_config("halt", norm_watch="recover", max_recoveries=2))
+    except NormBlowupError as e:
+        return "" if "budget exhausted" in str(e) else \
+            f"exhaustion diagnostic unclear: {e}"
+    except Exception as e:  # noqa: BLE001
+        return f"budget exhaustion raised the wrong error: {e}"
+    finally:
+        faults.reset()
+    return "budget-exhaustion run finished instead of halting"
+
+
 def phase_flaky_ingest(workdir: str) -> str:
     from glint_word2vec_tpu.data.corpus import encode_corpus
     from glint_word2vec_tpu.data.vocab import build_vocab
@@ -255,6 +315,7 @@ def main() -> int:
         ("nan-rollback", lambda: phase_nan("rollback")),
         ("nan-halt", lambda: phase_nan("halt")),
         ("norm-blowup", phase_norm_blowup),
+        ("norm-recover", phase_norm_recover),
         ("flaky-ingest",
          lambda: phase_flaky_ingest(os.path.join(workdir, "p4"))),
     ]

@@ -501,6 +501,30 @@ def main():
                          "frequent rows over long runs (measured: B=64k/P=64 NaNs at "
                          "17M words; B=64k/P=256 is stable at 17M but NaNs at 60M; "
                          "P>=512 holds at 60M; see EVAL.md)")
+    # --- in-step stabilizers + recovery (ISSUE 7 / ROADMAP 2): the ladder's
+    # judge. Rows carry both the REQUESTED knobs and the ENGAGED end state
+    # (recoveries_performed, lr_scale_final, engaged_max_row_norm) so the
+    # collapse-rung ladder compares mitigation variants on purity/analogy
+    # instead of vibes ---
+    ap.add_argument("--max-row-norm", type=float, default=0.0,
+                    help="per-touched-row L2 clamp on the update path (0=off)")
+    ap.add_argument("--update-clip", type=float, default=0.0,
+                    help="per-row L2 ceiling on each pair's update rows (0=off)")
+    ap.add_argument("--row-l2", type=float, default=0.0,
+                    help="touched-row weight decay (0=off)")
+    ap.add_argument("--norm-watch", default="off",
+                    choices=["off", "warn", "recover", "halt"],
+                    help="finite-blowup watchdog policy for the trained run "
+                         "('recover' = the full auto-recovery ladder)")
+    ap.add_argument("--stab-ab", action="store_true",
+                    help="train TWO arms on the identical corpus/seed — the "
+                         "unmitigated baseline (all stabilizers off, "
+                         "norm_watch off) and the stabilized arm (the "
+                         "--max-row-norm/--update-clip/--row-l2/--norm-watch "
+                         "knobs; defaults to max_row_norm=100 + "
+                         "norm_watch=recover when none given) — and emit one "
+                         "EVAL_RUNS row per arm, so the collapse rung judges "
+                         "the clamp/backoff variants on measured purity")
     args = ap.parse_args()
 
     from glint_word2vec_tpu.data.corpus import TokenFileCorpus
@@ -558,66 +582,131 @@ def main():
             log(f"reusing corpus at {corpus_path}")
 
     sents = TokenFileCorpus(corpus_path)
-    est = Word2Vec(
-        vector_size=args.dim, min_count=args.min_count, window=5, negatives=5,
-        negative_pool=args.pool,
-        pairs_per_batch=args.batch, steps_per_dispatch=32, num_iterations=args.iters,
-        learning_rate=lr, subsample_ratio=args.subsample, seed=args.seed,
-        param_dtype=args.param_dtype,
-        compute_dtype=args.param_dtype,
-        logits_dtype=args.logits_dtype or "float32",
-        # the EVAL suite's whole job is to MEASURE the divergence boundary, so
-        # it must be allowed to train configs the trainer would refuse
-        allow_unstable=True,
-        device_pairgen=args.device_pairgen, cbow=args.cbow)
-    t0 = time.perf_counter()
-    model = est.fit(sents, encode_cache_dir=os.path.join(
+    cache_dir = os.path.join(
         args.out, (f"encoded_{gen_tag}_{args.words}_{args.vocab}"
                    f"_{args.min_count}") if not args.corpus else
-        f"encoded_ext_{args.words}_{args.min_count}"))
-    train_s = time.perf_counter() - t0
-    log(f"trained: vocab {model.num_words:,}, d={args.dim}, {args.iters} iters "
-        f"in {train_s:.0f}s (incl. vocab+encode passes)")
+        f"encoded_ext_{args.words}_{args.min_count}")
 
-    np.save(os.path.join(args.out, "syn0.npy"),
-            np.asarray(model.syn0, np.float32))
-    with open(os.path.join(args.out, "vocab_words.txt"), "w") as f:
-        f.write("\n".join(model.vocab.words))
-    result = {
-        "metric": "topic_recovery_at_text8_scale",
-        "corpus_words": args.words,
-        "vocab_raw": args.vocab,
-        "vocab_size": model.num_words,
-        "dim": args.dim,
-        "iterations": args.iters,
-        "train_seconds_total": round(train_s, 1),
-        "param_dtype": args.param_dtype,
-        "logits_dtype": args.logits_dtype or "float32",
-        "pairs_per_batch": args.batch,
-        "negative_pool": args.pool,
-        "subsample_ratio": args.subsample,
-        "device_pairgen": bool(args.device_pairgen),
-        "cbow": bool(args.cbow),
-        "min_count": args.min_count,
-        # generator-constants provenance (rows are only comparable within one
-        # constants set; gen_version alone cannot distinguish tuning rounds)
-        "rel_sent_frac": REL_SENT_FRAC,
-        "rel_lambda_entity": REL_LAMBDA_ENTITY,
-        "rel_lambda_role": REL_LAMBDA_ROLE,
-        "learning_rate": lr,
-    }
-    if not args.corpus:
-        result.update(evaluate(model.vocab.words,
-                               np.asarray(model.syn0, np.float32),
-                               model.vocab.index))
-        # machine-readable run log: bench.py's headline cross-check refuses configs
-        # this file marks divergent or has never seen. Only ground-truth (synthetic
-        # corpus) runs qualify as stability evidence — external-corpus runs have no
-        # divergence metrics and are not appended.
-        repo_root = os.path.dirname(_here)
-        with open(os.path.join(repo_root, "EVAL_RUNS.jsonl"), "a") as f:
-            f.write(json.dumps(result) + "\n")
-    print(json.dumps(result))
+    def run_arm(stab: dict, save_arrays: bool, arm: str = ""):
+        """Train one configuration and score it; appends the EVAL_RUNS row
+        (ground-truth corpora only) carrying the requested stabilizer knobs
+        AND the engaged end state, and returns the result dict."""
+        est = Word2Vec(
+            vector_size=args.dim, min_count=args.min_count, window=5,
+            negatives=5, negative_pool=args.pool,
+            pairs_per_batch=args.batch, steps_per_dispatch=32,
+            num_iterations=args.iters,
+            learning_rate=lr, subsample_ratio=args.subsample, seed=args.seed,
+            param_dtype=args.param_dtype,
+            compute_dtype=args.param_dtype,
+            logits_dtype=args.logits_dtype or "float32",
+            # the EVAL suite's whole job is to MEASURE the divergence
+            # boundary, so it must be allowed to train configs the trainer
+            # would refuse
+            allow_unstable=True,
+            device_pairgen=args.device_pairgen, cbow=args.cbow, **stab)
+        from glint_word2vec_tpu.train.faults import (
+            NonFiniteParamsError, NormBlowupError)
+        t0 = time.perf_counter()
+        try:
+            model = est.fit(sents, encode_cache_dir=cache_dir)
+        except (NonFiniteParamsError, NormBlowupError) as e:
+            # an unmitigated arm may halt mid-run (that IS the measurement:
+            # the boundary); record the divergence as a row instead of
+            # killing the other arm's result
+            log(f"arm {arm or 'run'} diverged: {type(e).__name__}: "
+                f"{str(e)[:160]}")
+            result = {
+                "metric": "topic_recovery_at_text8_scale",
+                "corpus_words": args.words, "vocab_raw": args.vocab,
+                "dim": args.dim, "iterations": args.iters,
+                "pairs_per_batch": args.batch, "negative_pool": args.pool,
+                "subsample_ratio": args.subsample, "min_count": args.min_count,
+                "learning_rate": lr, "diverged": type(e).__name__,
+                **stab, **({"stab_ab_arm": arm} if arm else {})}
+            if not args.corpus:
+                with open(os.path.join(os.path.dirname(_here),
+                                       "EVAL_RUNS.jsonl"), "a") as f:
+                    f.write(json.dumps(result) + "\n")
+            return result
+        train_s = time.perf_counter() - t0
+        log(f"trained{f' [{arm}]' if arm else ''}: vocab "
+            f"{model.num_words:,}, d={args.dim}, {args.iters} iters "
+            f"in {train_s:.0f}s (incl. vocab+encode passes)")
+        if save_arrays:
+            np.save(os.path.join(args.out, "syn0.npy"),
+                    np.asarray(model.syn0, np.float32))
+            with open(os.path.join(args.out, "vocab_words.txt"), "w") as f:
+                f.write("\n".join(model.vocab.words))
+        result = {
+            "metric": "topic_recovery_at_text8_scale",
+            "corpus_words": args.words,
+            "vocab_raw": args.vocab,
+            "vocab_size": model.num_words,
+            "dim": args.dim,
+            "iterations": args.iters,
+            "train_seconds_total": round(train_s, 1),
+            "param_dtype": args.param_dtype,
+            "logits_dtype": args.logits_dtype or "float32",
+            "pairs_per_batch": args.batch,
+            "negative_pool": args.pool,
+            "subsample_ratio": args.subsample,
+            "device_pairgen": bool(args.device_pairgen),
+            "cbow": bool(args.cbow),
+            "min_count": args.min_count,
+            # generator-constants provenance (rows are only comparable within
+            # one constants set; gen_version alone cannot distinguish tuning
+            # rounds)
+            "rel_sent_frac": REL_SENT_FRAC,
+            "rel_lambda_entity": REL_LAMBDA_ENTITY,
+            "rel_lambda_role": REL_LAMBDA_ROLE,
+            "learning_rate": lr,
+            # requested stabilizer/recovery knobs + the ENGAGED end state
+            # (recovery may have backed lr off / engaged the clamp mid-run)
+            **stab,
+            **getattr(est, "last_run_stats", {}),
+            **({"stab_ab_arm": arm} if arm else {}),
+        }
+        if not args.corpus:
+            result.update(evaluate(model.vocab.words,
+                                   np.asarray(model.syn0, np.float32),
+                                   model.vocab.index))
+            # machine-readable run log: bench.py's headline cross-check
+            # refuses configs this file marks divergent or has never seen.
+            # Only ground-truth (synthetic corpus) runs qualify as stability
+            # evidence — external-corpus runs have no divergence metrics and
+            # are not appended.
+            repo_root = os.path.dirname(_here)
+            with open(os.path.join(repo_root, "EVAL_RUNS.jsonl"), "a") as f:
+                f.write(json.dumps(result) + "\n")
+        return result
+
+    stab = dict(max_row_norm=args.max_row_norm, update_clip=args.update_clip,
+                row_l2=args.row_l2, norm_watch=args.norm_watch)
+    if args.stab_ab:
+        if not (args.max_row_norm or args.update_clip or args.row_l2
+                or args.norm_watch != "off"):
+            # the default stabilized arm: the clamp at the watchdog-threshold
+            # provenance value + the full recovery ladder
+            stab = dict(max_row_norm=100.0, update_clip=0.0, row_l2=0.0,
+                        norm_watch="recover")
+        # the unmitigated arm is UNMITIGATED: no stabilizers, no watchdog,
+        # and no non-finite guardrail either (nonfinite_policy="none", the
+        # round-5 measurement posture) — a run that NaNs still trains to the
+        # end and scores, with the non-finite rows masked out of purity and
+        # counted in rows_inf, so the A/B always compares purity to purity
+        off = dict(max_row_norm=0.0, update_clip=0.0, row_l2=0.0,
+                   norm_watch="off", nonfinite_policy="none")
+        r_off = run_arm(off, save_arrays=False, arm="unmitigated")
+        r_stab = run_arm(stab, save_arrays=True, arm="stabilized")
+        delta = None
+        if "purity_at_10" in r_off and "purity_at_10" in r_stab:
+            delta = round(r_stab["purity_at_10"] - r_off["purity_at_10"], 4)
+        print(json.dumps({"metric": "stabilizer_ab",
+                          "purity_delta": delta,
+                          "arms": [r_off, r_stab]}))
+        return
+    print(json.dumps(run_arm(stab, save_arrays=True)))
 
 
 if __name__ == "__main__":

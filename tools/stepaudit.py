@@ -57,7 +57,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-VARIANTS = ("rows_gspmd", "shard_map", "cols", "cbow_banded")
+VARIANTS = ("rows_gspmd", "shard_map", "cols", "cbow_banded",
+            # stabilizer-on twins (ISSUE 7): the clamp/clip/decay ops ride
+            # inside the jitted chunk, so they must hold the same four
+            # contracts — donation (the touched-row scatter-set must not
+            # break aliasing), transfers, dtype (stabilizer norm math is
+            # promote(dtype, f32) — no f64 creep), one-compile
+            "rows_gspmd_stab", "shard_map_stab")
 # the bf16 twin of the rows step carries the dense-f32 check (contract c)
 BF16_VARIANT = "rows_gspmd_bf16"
 
@@ -92,6 +98,12 @@ def _variant_config_kwargs(variant: str) -> dict:
         return dict(embedding_partition="cols")
     if variant == "cbow_banded":
         return dict(cbow=True, cbow_update="banded", negative_pool=16)
+    if variant == "rows_gspmd_stab":
+        return dict(negative_pool=16, max_row_norm=50.0, update_clip=0.5,
+                    row_l2=1e-4)
+    if variant == "shard_map_stab":
+        return dict(step_lowering="shard_map", negative_pool=16,
+                    max_row_norm=50.0, update_clip=0.5, row_l2=1e-4)
     if variant == BF16_VARIANT:
         return dict(param_dtype="bfloat16", compute_dtype="bfloat16")
     raise ValueError(f"unknown variant {variant!r}")
