@@ -708,13 +708,89 @@ class Word2VecConfig:
                     "step_lowering='shard_map' is the rows-layout schedule "
                     "(owner-local row scatters); embedding_partition="
                     f"{self.embedding_partition!r} keeps GSPMD")
+        # --- device_pairgen selection matrix (graftcheck first-run findings,
+        # tools/graftcheck/ — these four refusals lived only in
+        # Trainer.__init__, so a config could be constructed/serialized that
+        # every Trainer would later reject; same parity discipline as the
+        # CBOW/pallas/step_lowering matrices above):
+        #   device_pairgen × cbow          → refuse (CBOW batches are grouped
+        #       windows the device generator does not produce)
+        #   device_pairgen × use_pallas    → refuse (pallas owns the step)
+        #   device_pairgen × window=1      → refuse (legacy asymmetric window
+        #       b = nextInt(1) = 0 emits no pairs at all)
+        #   device_pairgen × explicit tokens_per_step × window past the
+        #       2^24 exact-f32 prefix-sum bound → refuse (ops/pairgen
+        #       _cumsum_i32 exactness; an AUTO tokens_per_step=0 is sized by
+        #       the Trainer, which re-checks the derived value at dispatch)
+        if self.device_pairgen:
+            if self.cbow:
+                raise ValueError(
+                    "device_pairgen is skip-gram only (CBOW batches are "
+                    "grouped windows the device generator does not produce)")
+            if self.use_pallas:
+                raise ValueError(
+                    "device_pairgen is not supported with use_pallas — the "
+                    "fused kernel owns the whole step and consumes host "
+                    "pairs; drop one")
+            if self.window == 1:
+                raise ValueError(
+                    "device_pairgen with window=1 emits no pairs at all "
+                    "under the reference's legacy asymmetric window "
+                    "(b = nextInt(1) = 0 always, and the right bound is "
+                    "exclusive) — use window >= 2")
+            if (self.tokens_per_step > 0
+                    and self.tokens_per_step * (2 * self.window - 1) >= 1 << 24):
+                raise ValueError(
+                    f"tokens_per_step={self.tokens_per_step} with window="
+                    f"{self.window} overflows the device generator's "
+                    f"exact-f32 prefix-sum bound (T * (2*window - 1) must "
+                    f"stay below 2^24); lower tokens_per_step or split the "
+                    f"batch")
+        # cols × sharded_checkpoint: row-shards checkpoints need each process
+        # to own whole ROWS — the cols layout owns columns (design rationale:
+        # PERF.md §7). Trainer.__init__ keeps the runtime twin (cols ×
+        # multi-process), which depends on jax.process_count().
+        if self.embedding_partition == "cols" and self.sharded_checkpoint:
+            raise ValueError(
+                "embedding_partition='cols' does not support "
+                "sharded_checkpoint=True: row-shards checkpoints need each "
+                "process to own whole rows (design rationale: PERF.md §7); "
+                "use 'rows'")
         if self.num_data_shards <= 0:
             raise ValueError(
                 f"num_data_shards must be positive but got {self.num_data_shards}")
+        # dtype strings validated HERE, not first at jnp.dtype() inside
+        # _build_step: a typo'd dtype used to construct (and serialize)
+        # cleanly and then crash dispatch with a TypeError — the exact
+        # construction/dispatch gap class graftcheck's probe executes for
+        if self.param_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"param_dtype must be 'float32' or 'bfloat16' "
+                f"but got {self.param_dtype!r}")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be 'float32' or 'bfloat16' "
+                f"but got {self.compute_dtype!r}")
         if self.logits_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"logits_dtype must be 'float32' or 'bfloat16' "
                 f"but got {self.logits_dtype!r}")
+        # dispatch-geometry range checks (graftcheck registry audit): these
+        # three used to be unvalidated — steps_per_dispatch=0 or
+        # heartbeat_every_steps=0 constructed cleanly and died at fit() with
+        # a ZeroDivisionError, past every refusal surface
+        if self.steps_per_dispatch <= 0:
+            raise ValueError(
+                f"steps_per_dispatch must be positive "
+                f"but got {self.steps_per_dispatch}")
+        if self.heartbeat_every_steps <= 0:
+            raise ValueError(
+                f"heartbeat_every_steps must be positive "
+                f"but got {self.heartbeat_every_steps}")
+        if self.prefetch_chunks < 0:
+            raise ValueError(
+                f"prefetch_chunks must be nonnegative (0 = synchronous) "
+                f"but got {self.prefetch_chunks}")
         if self.tokens_per_step < 0:
             raise ValueError(
                 f"tokens_per_step must be nonnegative but got {self.tokens_per_step}")
@@ -783,18 +859,21 @@ class Word2VecConfig:
                 f"profile_steps must be nonnegative but got {self.profile_steps}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
-        if (getattr(self, "_auto_pool", False) and "negative_pool" not in kwargs
-                and any(k in kwargs for k in (
-                    "pairs_per_batch", "negatives",
-                    # these change which pool the AUTO rule resolves (banded
-                    # and shard_map force one at any batch size,
-                    # cbow+duplicate_scaling forces 0) — a frozen resolved
-                    # value would trip the selection-matrix refusals the user
-                    # never opted into
-                    "cbow", "cbow_update", "duplicate_scaling", "use_pallas",
-                    "step_lowering"))):
-            # the pool was auto-derived under the OLD geometry/path — re-derive
-            # it for the new one instead of freezing a now-wrong pool
+        if (getattr(self, "_auto_pool", False)
+                and "negative_pool" not in kwargs):
+            # the pool was auto-derived — re-derive it on the new config
+            # instead of freezing the resolved value. Pre-graftcheck this
+            # re-derived only when the flipped knob changed the AUTO rule's
+            # geometry/path inputs; any OTHER flip (seed, telemetry, ...)
+            # froze the resolved pool, which then read as EXPLICIT on the
+            # derived config — to_dict(auto_markers=True) stored it, and the
+            # Trainer's vocab-scaled re-resolution (load <= 160 past 500k
+            # vocab) silently skipped it. Re-resolution is deterministic in
+            # the geometry/path knobs, so under an unchanged geometry the
+            # value is unchanged too — only the AUTO-ness is (now correctly)
+            # preserved. graftcheck property (c) holds replace() to exactly
+            # this: equivalent to fresh construction from the auto-marker
+            # dict with the flip applied.
             kwargs["negative_pool"] = -1
         if (getattr(self, "_auto_subsample", False)
                 and "subsample_ratio" not in kwargs):

@@ -311,16 +311,23 @@ class Trainer:
                 shape = (1, 1)
             plan = make_mesh(*shape)
         self.plan = plan
-        if config.embedding_partition == "cols" and (
-                config.sharded_checkpoint or jax.process_count() > 1):
-            # design verdict, not a TODO (PERF.md §7): rows is the production
-            # layout — it divides the per-update-row scatter bound by the mesh
-            # size and owns whole rows for shard checkpoints; cols stays an
-            # experimental single-host option for the per-pair-sampling regime
+        # design verdict, not a TODO (PERF.md §7): rows is the production
+        # layout — it divides the per-update-row scatter bound by the mesh
+        # size and owns whole rows for shard checkpoints; cols stays an
+        # experimental single-host option for the per-pair-sampling regime.
+        # Two guards: the pure-config half (whose construction twin lives in
+        # config.__post_init__ — refusal parity, graftlint R8/graftcheck)
+        # and the runtime half (process count, which config cannot see).
+        if config.embedding_partition == "cols" and config.sharded_checkpoint:
             raise ValueError(
                 "embedding_partition='cols' is experimental and single-host only: "
-                "row-shards checkpoints and multi-process runs need each process "
-                "to own whole rows (design rationale: PERF.md §7); use 'rows'")
+                "row-shards checkpoints need each process to own whole rows "
+                "(design rationale: PERF.md §7); use 'rows'")
+        if config.embedding_partition == "cols" and jax.process_count() > 1:
+            raise ValueError(
+                "embedding_partition='cols' is experimental and single-host only: "
+                "multi-process runs need each process to own whole rows "
+                "(design rationale: PERF.md §7); use 'rows'")
         if (config.step_lowering == "shard_map"
                 and config.pairs_per_batch % plan.num_data):
             raise ValueError(
@@ -746,6 +753,12 @@ class Trainer:
             "(~%.0f, EVAL.md); pass subsample_ratio explicitly to pin a value",
             lo, cfg.pairs_per_batch, load, self._DUP_LOAD_REFUSE)
         self.config = cfg.replace(subsample_ratio=lo)
+        # replace() re-derives a still-AUTO pool with the CONFIG-level load
+        # rule (<= 600 — config cannot see the vocabulary), which would
+        # silently revert a vocab-scaled enlargement already applied at
+        # __init__; re-apply the large-vocab rule so the auto-lowered-
+        # subsample config keeps the safe pool (graftcheck-review finding)
+        self._resolve_vocab_scaled_pool()
 
     # Vocab-scaled AUTO pool rule, provenance EVAL.md round-5 ladder: the
     # config-time load <= 600 auto-rule was calibrated at 90k vocab, where

@@ -1,0 +1,22 @@
+"""R3 transitive-closure bad fixture: the host syncs live in a HELPER the
+jitted function calls by name (the obs/probe.py shape — `_matrix_stats` runs
+inside the fused probe but is not itself a jit target). Pre-closure R3 never
+walked it."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _stats_helper(m):
+    scale = float(m.sum())            # concretizes a tracer
+    t = time.perf_counter()           # host clock baked in at trace time
+    return jnp.max(m) * scale + t
+
+
+def make_probe():
+    def probe(params):
+        return _stats_helper(params)
+
+    return jax.jit(probe)

@@ -1,0 +1,23 @@
+"""R3 transitive-closure good twin: the helper the jitted probe calls stays
+device-pure; host-side flattening happens OUTSIDE the jit boundary on the
+fetched result (the obs/probe.py stats_to_channels split)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _stats_helper(m):
+    norms = jnp.sqrt(jnp.sum(m.astype(jnp.float32) ** 2, axis=1))
+    return jnp.max(norms)
+
+
+def make_probe():
+    def probe(params):
+        return _stats_helper(params)
+
+    return jax.jit(probe)
+
+
+def fetched_to_channels(stats):
+    # host-side: runs on the FETCHED result, outside any jit — allowed
+    return {"max_norm": float(stats)}

@@ -11,6 +11,7 @@ import dataclasses
 @dataclasses.dataclass
 class Word2VecConfig:
     cbow: bool = False
+    device_pairgen: bool = False
     use_pallas: bool = False
     negative_pool: int = -1
     max_row_norm: float = 0.0

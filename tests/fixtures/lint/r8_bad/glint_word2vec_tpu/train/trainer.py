@@ -1,11 +1,19 @@
-"""R8 bad trainer half: three dispatch-only refusals — one with no config
+"""R8 bad trainer half: four dispatch-only refusals — one with no config
 twin at all (cbow x use_pallas), one 'covered' only by a single-knob range
-check (cbow x negative_pool), which is not coverage, and one on a NEW
+check (cbow x negative_pool), which is not coverage, one on a NEW
 stabilizer knob (use_pallas x max_row_norm) whose range check in config is
-likewise not combination coverage."""
+likewise not combination coverage, and one living in __init__ path
+selection rather than _build_step (the device_pairgen class graftcheck's
+first run caught in the real tree)."""
 
 
 class Trainer:
+    def __init__(self, config):
+        self.config = config
+        if config.device_pairgen:
+            if config.cbow:
+                raise ValueError("device feed is skip-gram only")
+
     def _build_step(self):
         cfg = self.config
         if cfg.use_pallas:

@@ -7,6 +7,7 @@ import dataclasses
 @dataclasses.dataclass
 class Word2VecConfig:
     cbow: bool = False
+    device_pairgen: bool = False
     use_pallas: bool = False
     negative_pool: int = -1
     max_row_norm: float = 0.0
@@ -24,5 +25,7 @@ class Word2VecConfig:
                 raise ValueError("use_pallas is SGNS-only")
             if self.max_row_norm:
                 raise ValueError("stabilizers are XLA-path only")
+        if self.device_pairgen and self.cbow:
+            raise ValueError("device feed is skip-gram only")
         if self.cbow and self.negative_pool == 0:
             raise ValueError("cbow needs the shared pool here")

@@ -1,7 +1,14 @@
-"""R8 good trainer half: same dispatch guards; config carries both twins."""
+"""R8 good trainer half: same dispatch guards (including the __init__ one);
+config carries every twin."""
 
 
 class Trainer:
+    def __init__(self, config):
+        self.config = config
+        if config.device_pairgen:
+            if config.cbow:
+                raise ValueError("device feed is skip-gram only")
+
     def _build_step(self):
         cfg = self.config
         if cfg.use_pallas:

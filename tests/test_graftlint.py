@@ -57,6 +57,42 @@ def test_r3_flags_every_host_sync_kind():
     assert "float" in msgs and "asarray" in msgs and "clock" in msgs
 
 
+def test_r3_transitive_helper_coverage():
+    """ISSUE 8 satellite: host syncs in a same-module HELPER the jitted
+    function calls by name (the obs/probe.py `_matrix_stats` shape) are in
+    scope; the good twin (device-pure helper, host flattening outside the
+    jit boundary) stays silent."""
+    vpath = "glint_word2vec_tpu/obs/somefile.py"
+    bad = engine.lint_text(_fixture("r3_trans_bad.py"), vpath)
+    msgs = " ".join(f.message for f in bad if f.rule == "R3")
+    assert "concretizes" in msgs and "clock" in msgs, bad
+    good = engine.lint_text(_fixture("r3_trans_good.py"), vpath)
+    assert not [f for f in good if f.rule == "R3"], good
+
+
+def test_r3_reaches_the_real_probe_helpers():
+    """The closure genuinely covers obs/probe.py: poisoning `_matrix_stats`
+    (called from the jitted fused probe, not itself a jit target) with a
+    float() concretization must fire R3."""
+    path = os.path.join(REPO, "glint_word2vec_tpu", "obs", "probe.py")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    poisoned = src.replace(
+        "    return MatrixStats(",
+        "    bad = float(norms.sum())\n    return MatrixStats(")
+    assert poisoned != src, "probe.py refactored — update the poison anchor"
+    found = engine.lint_text(poisoned, "glint_word2vec_tpu/obs/probe.py")
+    assert [f for f in found if f.rule == "R3"], found
+    # and the committed module itself is clean under the wider scan
+    clean = engine.lint_text(src, "glint_word2vec_tpu/obs/probe.py")
+    assert not [f for f in clean if f.rule == "R3"], clean
+    watch_path = os.path.join(REPO, "glint_word2vec_tpu", "obs", "watch.py")
+    with open(watch_path, "r", encoding="utf-8") as f:
+        watch_src = f.read()
+    assert not [f for f in engine.lint_text(
+        watch_src, "glint_word2vec_tpu/obs/watch.py") if f.rule == "R3"]
+
+
 def test_r7_counts_second_json_line():
     bad = engine.lint_text(_fixture("r7_bad.py"), _VPATH["R7"])
     assert any("exactly ONE JSON line" in f.message for f in bad)
@@ -74,8 +110,43 @@ def test_r8_fires_on_bad_pair_and_not_on_good_pair():
     # a NEW stabilizer-class knob with a dispatch-only refusal (ISSUE 7):
     # the range check on max_row_norm must not count as combo coverage
     assert any("max_row_norm" in m and "use_pallas" in m for m in msgs), bad
+    # a refusal living in Trainer.__init__ path selection, not _build_step —
+    # the device_pairgen class graftcheck's first run caught in the real
+    # tree (ISSUE 8): __init__ is now a scanned dispatch surface
+    assert any("device_pairgen" in m and "cbow" in m for m in msgs), bad
     good = rule.check_repo(os.path.join(FIXTURES, "r8_good"))
     assert not good, good
+
+
+def test_r8_cross_references_graftcheck_registry():
+    """R8's graftcheck cross-reference: every config field needs a knob
+    entry in tools/graftcheck/registry.py. Verified both ways — the real
+    tree is clean, and a field invented on a copied config must be flagged
+    as missing from the registry."""
+    import shutil
+    import tempfile
+
+    rule = R8RefusalParity()
+    assert not [f for f in rule.check_repo(REPO)
+                if "registry" in f.message], "real tree should be in sync"
+    with tempfile.TemporaryDirectory() as td:
+        for rel in ("glint_word2vec_tpu/config.py",
+                    "glint_word2vec_tpu/train/trainer.py",
+                    "tools/graftcheck/registry.py"):
+            dst = os.path.join(td, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.copy(os.path.join(REPO, rel), dst)
+        cfg_path = os.path.join(td, "glint_word2vec_tpu", "config.py")
+        with open(cfg_path, "r", encoding="utf-8") as f:
+            src = f.read()
+        src = src.replace("    vector_size: int = 100",
+                          "    brand_new_knob: int = 0\n"
+                          "    vector_size: int = 100")
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            f.write(src)
+        found = rule.check_repo(td)
+        assert any("brand_new_knob" in f.message and "registry" in f.message
+                   for f in found), found
 
 
 def test_suppression_requires_justification():

@@ -157,7 +157,16 @@ def test_column_sharding_rejects_sharded_checkpoint():
 
     sents = [["a", "b", "c"]] * 10
     vocab = build_vocab(sents, min_count=1)
+    # refused at CONSTRUCTION since the graftcheck parity sweep (the refusal
+    # used to live only in Trainer.__init__, so the config could be
+    # serialized before any Trainer rejected it)
+    with pytest.raises(ValueError, match="cols"):
+        Word2VecConfig(vector_size=128, min_count=1,
+                       embedding_partition="cols", sharded_checkpoint=True)
+    # and the dispatch-side twin still refuses a config smuggled past
+    # validation (the R8 parity discipline keeps both)
     cfg = Word2VecConfig(vector_size=128, min_count=1,
-                         embedding_partition="cols", sharded_checkpoint=True)
+                         embedding_partition="cols")
+    object.__setattr__(cfg, "sharded_checkpoint", True)
     with pytest.raises(ValueError, match="cols"):
         Trainer(cfg, vocab, plan=make_mesh(1, 8))

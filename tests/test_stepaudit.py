@@ -45,6 +45,12 @@ def test_stepaudit_smoke_all_variants():
         assert r["recompile"]["compiles"] == 1, (name, r)
     bf16 = result["variants"][stepaudit.BF16_VARIANT]
     assert bf16["dtype"]["dense_f32_vd_free"] is True
+    # the recover-rebuild contract (ISSUE 8): one recovery, twins rebuilt
+    # once, exactly one extra compile — 2 total for the whole
+    # blowup-and-recover fit
+    rr = result["recover_rebuild"]
+    assert rr["ok"] and rr["recoveries"] == 1 and rr["rebuilt"], rr
+    assert rr["total_compiles"] == rr["expected_total_compiles"] == 2, rr
 
     with open(os.path.join(REPO, "STEPAUDIT.json"), "r") as f:
         baseline = json.load(f)
@@ -53,6 +59,7 @@ def test_stepaudit_smoke_all_variants():
         for field in ("donation", "dtype", "recompile"):
             assert result["variants"][name][field] == \
                 baseline["variants"][name][field], (name, field)
+    assert result["recover_rebuild"] == baseline["recover_rebuild"]
 
 
 def test_auditor_catches_dropped_donation():
@@ -92,6 +99,25 @@ def test_auditor_catches_dropped_staging(monkeypatch):
     assert not res["transfers"]["ok"]
     assert "transfer" in (res["transfers"]["error"] or "").lower()
     assert not res["ok"]
+
+
+def test_auditor_catches_recovery_without_rebuild(monkeypatch):
+    """The recover-rebuild audit's own regression coverage: a recovery that
+    rolls back and backs lr off but never rebuilds the step twins (so the
+    engaged clamp would silently not exist in the compiled step) must fail
+    the contract."""
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    def no_rebuild(self, reason, channels):
+        self._restore_snapshot()
+        self.recoveries_performed += 1
+        self._lr_scale *= self.config.recover_lr_backoff
+
+    monkeypatch.setattr(Trainer, "_perform_recovery", no_rebuild)
+    res = stepaudit.audit_recover_rebuild(stepaudit.smoke_geometry())
+    assert res["recoveries"] == 1
+    assert not res["rebuilt"] and res["compiles_after"] == 0
+    assert not res["ok"], res
 
 
 def test_audit_variant_in_process_shard_map():

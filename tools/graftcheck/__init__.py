@@ -1,0 +1,32 @@
+"""graftcheck — layer 3 of the static-analysis subsystem: an EXECUTING model
+checker over the ``Word2VecConfig`` knob lattice.
+
+Where graftlint R8 diffs the config/trainer refusal matrices as AST (what the
+source *promises*) and stepaudit checks the compiled artifact, graftcheck
+enumerates the 61-knob lattice from a declarative registry and actually RUNS
+each candidate through the contracts the five historical serialization bugs
+violated (docs/static-analysis.md has the catalogue):
+
+(a) construction/dispatch refusal parity — construct the config, then build a
+    real ``Trainer`` against a fixed probe vocabulary/mesh and assert no combo
+    is refused at dispatch that construction accepted (runtime-only refusals —
+    device counts, process divisibility, corpus-dependent channels — are
+    classified and exempt, exactly R8's exemption, but checked empirically);
+(b) serialization fixpoints — ``from_dict(to_dict(c))`` reaches a fixpoint
+    under both ``auto_markers`` modes, through a JSON round trip, and AUTO-ness
+    (pool ``-1``, subsample marker) survives;
+(c) ``replace()`` re-resolution parity — a knob flip via ``replace()`` is
+    equivalent (same acceptance, same serialized form, same AUTO flags) to
+    fresh construction from the auto-marker dict with the flip applied;
+(d) checkpoint-normalization monotonicity — every documented old-dict
+    normalization (stored resolved pool beside cbow+duplicate_scaling,
+    unknown-key filtering, mesh_shape list→tuple) produces a config that
+    constructs cleanly.
+
+Violations shrink to minimal (≤3-knob) counterexamples; the expected refusal
+signatures live in the committed ``baseline.json`` with a drift gate in both
+directions. ``python -m tools.graftcheck`` prints exactly one JSON line on
+stdout (the R7 contract); ``--smoke`` is the tier-1 wiring, the full sweep
+(all 61 knobs pairwise + exhaustive refusal-relevant subsets, ≥1,000 executed
+configs) runs in CI.
+"""

@@ -138,7 +138,11 @@ class R2Prng:
 def _jit_wrapped_functions(ctx: ModuleContext):
     """FunctionDef/Lambda nodes that are jit/shard_map targets: decorated
     (`@jax.jit`, `@partial(jax.jit, ...)`), or passed by name/inline to a
-    `jax.jit(...)` / `jit(...)` / `shard_map(...)` call in this module."""
+    `jax.jit(...)` / `jit(...)` / `shard_map(...)` call in this module —
+    PLUS the transitive closure of same-module helpers they call by name
+    (ISSUE 8 satellite: obs/probe.py's `_matrix_stats` runs inside the
+    jitted fused probe but is not itself a jit target, so the pre-closure
+    rule never walked it)."""
     wrapper_names = ("jit", "shard_map")
 
     def is_wrapper(call: ast.Call) -> bool:
@@ -169,6 +173,31 @@ def _jit_wrapped_functions(ctx: ModuleContext):
                             for a in dec.args)):
                     out.append(node)
                     break
+    # transitive closure over same-module helpers called by simple name from
+    # any wrapped function (nested defs are already inside ast.walk(fn); this
+    # adds the module-level/sibling helpers a trace reaches). Cross-module
+    # calls stay out of scope — each module is linted on its own. Class
+    # METHODS are excluded from the name map: a bare-name call cannot reach
+    # them (they need an instance), and a host-only method sharing a helper's
+    # name would otherwise be linted as jit context (false positives).
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                not isinstance(ctx.parents.get(node), ast.ClassDef):
+            defs_by_name.setdefault(node.name, []).append(node)
+    seen = set(id(fn) for fn in out)
+    frontier = list(out)
+    while frontier:
+        fn = frontier.pop()
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)):
+                continue
+            for helper in defs_by_name.get(call.func.id, []):
+                if id(helper) not in seen:
+                    seen.add(id(helper))
+                    out.append(helper)
+                    frontier.append(helper)
     return out
 
 
@@ -395,7 +424,7 @@ class R7JsonStdout:
     _CONTRACT_MODULES = {
         "bench.py", "__graft_entry__.py", "tools/hostbench.py",
         "tools/collectives.py", "tools/shard_ab.py", "tools/stepaudit.py",
-        "tools/telemetry_run.py",
+        "tools/telemetry_run.py", "tools/graftcheck/__main__.py",
     }
 
     def applies(self, path: str) -> bool:
@@ -438,14 +467,21 @@ class R7JsonStdout:
 
 # ---------------------------------------------------------------------------
 # R8 — refusal-matrix parity (repo rule): every knob combination the trainer
-# refuses at _build_step dispatch must also be refused by
-# config.__post_init__ validation, so an unsupported config fails at
-# CONSTRUCTION (cheap, local, before any accelerator time) and a checkpoint
-# can never be written with knobs the dispatch will later refuse. Both
-# matrices are parsed from the AST (conditions on config attributes guarding
-# a `raise ValueError`) and diffed; dispatch-side guards that also test
-# non-config state (mesh size, process count) are runtime conditions and are
-# exempt from the diff.
+# refuses at dispatch (__init__ path selection or _build_step) must also be
+# refused by config.__post_init__ validation, so an unsupported config fails
+# at CONSTRUCTION (cheap, local, before any accelerator time) and a
+# checkpoint can never be written with knobs the dispatch will later refuse.
+# Both matrices are parsed from the AST (conditions on config attributes
+# guarding a `raise ValueError`) and diffed; dispatch-side guards that also
+# test non-config state (mesh size, process count) are runtime conditions
+# and are exempt from the diff.
+#
+# R8 is the STATIC half of the parity discipline; tools/graftcheck/ is the
+# empirical twin that actually executes the lattice (it catches the guards
+# this AST diff must exempt — conditions mixing config and runtime state —
+# by probing a real Trainer). The cross-reference enforced here: graftcheck's
+# knob registry must enumerate every config field, so the executing checker
+# can never silently under-cover the surface this rule parses.
 # ---------------------------------------------------------------------------
 class R8RefusalParity:
     id = "R8"
@@ -453,7 +489,8 @@ class R8RefusalParity:
 
     _CONFIG = _LIB + "config.py"
     _TRAINER = _LIB + "train/trainer.py"
-    _DISPATCH_FNS = {"_build_step", "_build_banded_cbow_chunk"}
+    _DISPATCH_FNS = {"_build_step", "_build_banded_cbow_chunk", "__init__"}
+    _GRAFTCHECK_REGISTRY = "tools/graftcheck/registry.py"
 
     @staticmethod
     def _knobs_in(test: ast.AST, selves: Set[str],
@@ -557,12 +594,61 @@ class R8RefusalParity:
                        for cfg_combo in cfg_matrix):
                 findings.append(Finding(
                     rule=self.id, path=self._TRAINER, line=0, col=0,
-                    message=f"knob combination refused at _build_step "
-                            f"dispatch but not in config.__post_init__ "
+                    message=f"knob combination refused at trainer dispatch "
+                            f"but not in config.__post_init__ "
                             f"validation: {sorted(combo)} — add the "
                             f"construction-time refusal (selection-matrix "
-                            f"parity)"))
+                            f"parity; graftcheck executes the empirical "
+                            f"twin of this check)"))
+        findings.extend(self._check_graftcheck_registry(root, fields))
         return findings
+
+    def _check_graftcheck_registry(self, root: str,
+                                   fields: Set[str]) -> List[Finding]:
+        """Cross-reference to the EXECUTING checker: every config field must
+        have a knob entry in tools/graftcheck/registry.py, else graftcheck's
+        lattice silently under-covers the refusal surface this rule parses.
+        Skipped when the graftcheck package is absent (the R8 fixture
+        mini-repos); the real tree always carries it.
+
+        DELIBERATELY redundant with registry.registry_drift(): that gate
+        runs by importing the live config (and therefore jax); this one is
+        pure AST, so the lint layer keeps working when graftcheck itself is
+        broken or unimportable — the two gates cross-check each other. The
+        AST scan only recognizes literal ``_K("name", ...)`` entries, which
+        the registry's own docstring mandates (a knob built by loop/variable
+        would be flagged here — that is the desired outcome, not a bug)."""
+        reg_dir = os.path.join(root, "tools", "graftcheck")
+        if not os.path.isdir(reg_dir):
+            return []
+        reg_path = os.path.join(root, *self._GRAFTCHECK_REGISTRY.split("/"))
+        try:
+            with open(reg_path, "r", encoding="utf-8") as f:
+                reg_tree = ast.parse(f.read())
+        except (OSError, SyntaxError) as e:
+            return [Finding(
+                rule=self.id, path=self._GRAFTCHECK_REGISTRY, line=0, col=0,
+                message=f"cannot parse the graftcheck knob registry: {e}")]
+        declared: Set[str] = set()
+        for node in ast.walk(reg_tree):
+            if (isinstance(node, ast.Call)
+                    and _name_of(node.func) in ("_K", "Knob")
+                    and node.args and isinstance(node.args[0], ast.Constant)):
+                declared.add(str(node.args[0].value))
+        out: List[Finding] = []
+        for name in sorted(fields - declared):
+            out.append(Finding(
+                rule=self.id, path=self._GRAFTCHECK_REGISTRY, line=0, col=0,
+                message=f"config field {name!r} has no knob entry in the "
+                        f"graftcheck registry — the executing lattice "
+                        f"under-covers the refusal surface; declare its "
+                        f"sampled domain"))
+        for name in sorted(declared - fields):
+            out.append(Finding(
+                rule=self.id, path=self._GRAFTCHECK_REGISTRY, line=0, col=0,
+                message=f"graftcheck registry knob {name!r} does not exist "
+                        f"on Word2VecConfig — drop the stale entry"))
+        return out
 
 
 ALL_RULES = [R1ThreadPools(), R2Prng(), R3TracerDiscipline(), R4PrefixDtype(),

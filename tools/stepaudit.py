@@ -224,6 +224,89 @@ def audit_variant(variant: str, mesh_shape, geom: dict) -> dict:
     }
 
 
+def audit_recover_rebuild(geom: dict) -> dict:
+    """ISSUE 8 satellite: the ``norm_watch="recover"`` escalation ladder
+    auto-engages ``max_row_norm`` on first firing, which REBUILDS the step
+    twins — documented as "one recompile per engagement, logged"
+    (trainer._perform_recovery), but until now nothing machine-checked it.
+    This audit drives a real recovery through a scripted finite blowup
+    (train.faults scale injection — the same deterministic hook the chaos
+    schedule uses) and asserts the one-logged-recompile contract:
+
+    - exactly ONE recovery fires and the step twins are rebuilt once;
+    - the pre-recovery twins hold the usual one-compile contract;
+    - the REBUILT twins compile exactly once more — total 2 compiles for the
+      whole blowup-and-recover fit, not a recompile-per-dispatch storm;
+    - the engaged clamp is the watchdog threshold (the boundary the firing
+      measured health by).
+    """
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.parallel.mesh import make_mesh
+    from glint_word2vec_tpu.train import faults
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    vocab, enc = _toy_problem(geom)
+    cfg = Word2VecConfig(
+        vector_size=geom["d"], min_count=1, pairs_per_batch=geom["b"],
+        num_iterations=2, window=2, steps_per_dispatch=2,
+        heartbeat_every_steps=2, prefetch_chunks=0, subsample_ratio=0.0,
+        norm_watch="recover", nonfinite_policy="halt")
+    trainer = Trainer(cfg, vocab, plan=make_mesh(1, 1))
+    pre_full, pre_fast = trainer._step_fn, trainer._step_fn_fast
+
+    rebuilds = []
+    orig_build = trainer._build_step
+
+    def counting_build(with_metrics: bool = True):
+        rebuilds.append(with_metrics)
+        return orig_build(with_metrics)
+
+    trainer._build_step = counting_build
+
+    error = None
+    faults.configure(scale_params_at_step=8)
+    try:
+        trainer.fit(enc)
+    except Exception as e:  # noqa: BLE001 — reported, not raised (audit style)
+        error = f"{type(e).__name__}: {e}"[:500]
+    finally:
+        faults.reset()
+        trainer._build_step = orig_build
+
+    post_full, post_fast = trainer._step_fn, trainer._step_fn_fast
+    rebuilt = post_full is not pre_full
+
+    def twin_compiles(full, fast):
+        n = full._cache_size()
+        if fast is not full:
+            n += fast._cache_size()
+        return int(n)
+
+    compiles_before = twin_compiles(pre_full, pre_fast)
+    compiles_after = twin_compiles(post_full, post_fast) if rebuilt else 0
+    engaged = float(trainer._stabilizers.max_row_norm)
+    result = {
+        "error": error,
+        "recoveries": int(trainer.recoveries_performed),
+        "watchdog_fires": int(trainer.norm_watchdog.fires),
+        "rebuilt": bool(rebuilt),
+        "rebuild_calls": len(rebuilds),
+        "compiles_before": compiles_before,
+        "compiles_after": compiles_after,
+        "total_compiles": compiles_before + compiles_after,
+        "engaged_max_row_norm": engaged,
+        "expected_total_compiles": 2,
+    }
+    result["ok"] = bool(
+        error is None
+        and result["recoveries"] == 1
+        and rebuilt
+        and compiles_before == 1
+        and compiles_after == 1
+        and engaged == cfg.norm_watch_threshold)
+    return result
+
+
 def audit(mesh_shape=(2, 4), geom=None, variants=None) -> dict:
     """Audit the given variants (default: all four + the bf16 dtype twin) at
     one mesh shape. Importable — __graft_entry__.dryrun_multichip embeds a
@@ -270,7 +353,15 @@ def run(argv=None) -> dict:
             "so the CPU mesh self-provisions, or set "
             "--xla_force_host_platform_device_count")
 
-    result = audit(shape, smoke_geometry() if args.smoke else full_geometry())
+    geom = smoke_geometry() if args.smoke else full_geometry()
+    result = audit(shape, geom)
+    log("stepaudit: auditing the norm_watch='recover' rebuild contract ...")
+    result["recover_rebuild"] = audit_recover_rebuild(geom)
+    rr = result["recover_rebuild"]
+    log(f"  recover_rebuild  recoveries={rr['recoveries']} "
+        f"rebuilt={rr['rebuilt']} total_compiles={rr['total_compiles']} "
+        f"ok={rr['ok']}")
+    result["ok"] = bool(result["ok"] and rr["ok"])
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(result, f, indent=1)
