@@ -494,6 +494,31 @@ class Word2VecConfig:
                                     # keeps pod traces loadable — whole-fit
                                     # traces at production step counts are
                                     # multi-GB
+    status_port: int = 0            # > 0: serve a read-only live-inspection
+                                    # HTTP endpoint on 127.0.0.1:<port> for
+                                    # the duration of each fit
+                                    # (obs/statusd.py): /status.json (the
+                                    # gauge snapshot as JSON), /metrics
+                                    # (Prometheus text format, glint_*
+                                    # gauges), /healthz. 0 (default) = off
+                                    # with ZERO cost — no thread is created
+                                    # and no socket bound (tested). The
+                                    # endpoint only READS trainer state; it
+                                    # can never interleave device work into
+                                    # the dispatch pipeline
+    blackbox_ring: int = 256        # flight-recorder capacity (obs/
+                                    # blackbox.py): how many per-dispatch
+                                    # metadata records the in-memory ring
+                                    # holds (recent heartbeats and watchdog/
+                                    # recovery events keep a quarter of this
+                                    # each). The ring dumps atomically to
+                                    # <telemetry_path>.blackbox.json when a
+                                    # fit dies (exception, NormBlowupError,
+                                    # SIGTERM), so a remote death leaves a
+                                    # diagnosis artifact instead of a
+                                    # truncated JSONL. Only active when
+                                    # telemetry_path is set (the dump path
+                                    # derives from it)
 
     def __post_init__(self) -> None:
         if self.embedding_partition not in ("rows", "cols"):
@@ -857,6 +882,13 @@ class Word2VecConfig:
         if self.profile_steps < 0:
             raise ValueError(
                 f"profile_steps must be nonnegative but got {self.profile_steps}")
+        if not (0 <= self.status_port <= 65535):
+            raise ValueError(
+                f"status_port must be in [0, 65535] (0 = off) "
+                f"but got {self.status_port}")
+        if self.blackbox_ring <= 0:
+            raise ValueError(
+                f"blackbox_ring must be positive but got {self.blackbox_ring}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
         if (getattr(self, "_auto_pool", False)

@@ -73,18 +73,12 @@ class Word2Vec:
         trainer.fit(encoded, checkpoint_path=checkpoint_path,
                     checkpoint_every_steps=checkpoint_every_steps)
         params = trainer.unpadded_params()
-        # runtime robustness outcome of the fit (docs/robustness.md ladder) —
-        # the EVAL harness emits this into its rows so a stabilizer A/B can
-        # report the ENGAGED mitigation state, not just the requested knobs
-        self.last_run_stats = {
-            "watchdog_fires": int(trainer.norm_watchdog.fires),
-            "rollbacks_performed": int(trainer.rollbacks_performed),
-            "recoveries_performed": int(trainer.recoveries_performed),
-            "lr_scale_final": float(trainer._lr_scale),
-            "engaged_max_row_norm": float(trainer._stabilizers.max_row_norm),
-            "engaged_update_clip": float(trainer._stabilizers.update_clip),
-            "engaged_row_l2": float(trainer._stabilizers.row_l2),
-        }
+        # runtime outcome of the fit (docs/robustness.md ladder +
+        # docs/observability.md attribution): the EVAL harness emits this
+        # into its rows so a stabilizer A/B reports the ENGAGED mitigation
+        # state, and a telemetry-on run additionally carries the per-phase
+        # time rollup. One owner: Trainer.last_run_stats.
+        self.last_run_stats = trainer.last_run_stats
         return Word2VecModel(
             vocab=vocab, syn0=params.syn0, syn1=params.syn1,
             config=cfg, plan=trainer.plan, train_state=trainer.state)

@@ -1,6 +1,6 @@
 """Run-telemetry subsystem (docs/observability.md).
 
-Four layers, each usable alone, all off by default and zero-cost when off:
+Seven layers, each usable alone, all off by default and zero-cost when off:
 
 - :mod:`.probe` — the fused on-device health reduction over the params carry
   (finiteness + per-matrix row-norm channels), the instrumentation ROADMAP
@@ -11,16 +11,34 @@ Four layers, each usable alone, all off by default and zero-cost when off:
   (rotating file, never stdout — graftlint R7).
 - :mod:`.spans` — thread-safe host trace spans exported as Chrome-trace JSON
   (Perfetto-loadable).
+- :mod:`.phases` — host-side per-phase log2 duration histograms (producer
+  wait / stage / dispatch / device block), the "where did the time go"
+  attribution without a trace viewer.
+- :mod:`.blackbox` — the flight recorder: bounded rings of recent telemetry
+  dumped atomically to ``<telemetry_path>.blackbox.json`` on fit death.
+- :mod:`.statusd` — the read-only live-inspection HTTP endpoint
+  (``config.status_port``): JSON + Prometheus gauges for a running fit.
 """
 
+from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+from glint_word2vec_tpu.obs.phases import PhaseAccumulator
 from glint_word2vec_tpu.obs.probe import HealthStats, make_health_probe
-from glint_word2vec_tpu.obs.schema import SCHEMA_VERSION, validate_file, validate_record
+from glint_word2vec_tpu.obs.schema import (
+    SCHEMA_VERSION,
+    validate_blackbox,
+    validate_blackbox_file,
+    validate_file,
+    validate_record,
+)
 from glint_word2vec_tpu.obs.sink import TelemetrySink
 from glint_word2vec_tpu.obs.spans import Tracer, default_tracer
+from glint_word2vec_tpu.obs.statusd import StatusServer, prometheus_text
 from glint_word2vec_tpu.obs.watch import NormWatchdog
 
 __all__ = [
     "HealthStats", "make_health_probe",
     "SCHEMA_VERSION", "validate_file", "validate_record",
+    "validate_blackbox", "validate_blackbox_file",
     "TelemetrySink", "Tracer", "default_tracer", "NormWatchdog",
+    "FlightRecorder", "PhaseAccumulator", "StatusServer", "prometheus_text",
 ]

@@ -1,0 +1,177 @@
+"""Flight recorder: a bounded in-memory ring that dumps on fit death.
+
+An hour-3 death on a remote host today leaves a truncated JSONL and a
+traceback in a terminated terminal — nothing that says what the run was
+doing when it died. The recorder mirrors the tail of the telemetry stream
+(recent heartbeats, watchdog/recovery records, per-dispatch metadata) in
+memory and, when the run dies, writes one self-contained JSON document —
+``<telemetry_path>.blackbox.json`` — atomically (tmp + ``os.replace``, the
+checkpoint swap discipline), stamped with the terminal cause. Dump
+triggers, all riding paths that already exist (docs/observability.md):
+
+- any fit-aborting exception — the trainer's ``except BaseException:
+  _abort_run(); raise`` arms the dump with the in-flight exception (this
+  covers ``NormBlowupError``, ``NonFiniteParamsError``, feed errors, and
+  ``KeyboardInterrupt``/SIGINT, which Python delivers as an exception);
+- SIGTERM — the first signal a preemption/k8s eviction sends; the trainer
+  installs a handler for the duration of fit() that dumps, restores the
+  previous disposition, and re-raises the signal so exit semantics are
+  untouched (trainer._install_run_signals).
+
+The ring is bounded (``config.blackbox_ring`` dispatch records; heartbeats
+and watchdog/recovery events keep smaller fixed fractions) so a weeks-long
+run holds kilobytes, and the DUMP is what costs — feeding the ring is a
+lock + deque append per dispatch round, nothing on the step path. The
+recorder exists only when telemetry is on (the dump path derives from
+``telemetry_path``); a telemetry-off trainer has none.
+
+Dump document format (validated by ``obs.schema.validate_blackbox``): one
+JSON object with ``schema``/``kind="blackbox"``/``t``, the ``run_id``, a
+``cause`` record (exception | signal | none), the ring contents
+(``heartbeats``/``events``/``dispatches`` — heartbeats and events are the
+SAME schema records the sink wrote, so one validator covers both files),
+and the at-death ``phases``/``spans``/``status`` snapshots.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, Optional
+
+from glint_word2vec_tpu.obs.schema import SCHEMA_VERSION
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+# sink-record kinds mirrored into the event ring (everything that is not a
+# heartbeat or a dispatch: watchdog firings, recovery-ladder actions, the
+# run_start/run_end bracketing records)
+_EVENT_KINDS = ("run_start", "run_end", "watchdog", "recovery")
+
+
+class FlightRecorder:
+    """Bounded rings of recent telemetry + per-dispatch metadata, dumped
+    atomically to ``path`` on fit death."""
+
+    def __init__(self, path: str, ring: int = 256):
+        if ring <= 0:
+            raise ValueError(f"blackbox ring must be positive but got {ring}")
+        self.path = path
+        # RLock, not Lock: the SIGTERM dump runs ON the main thread at a
+        # bytecode boundary — possibly while that same thread is inside
+        # note_dispatch()/observe() holding this lock. A non-reentrant lock
+        # would deadlock the handler through the kill grace period and the
+        # process would die dumpless — the exact failure this class exists
+        # to prevent. (Same rule in phases/spans/sink: every lock the
+        # handler's dump path can touch is reentrant.)
+        self._lock = threading.RLock()
+        # dispatches dominate volume (one per round); heartbeats arrive at
+        # 1/heartbeat_every_steps of that and events are rarer still — the
+        # smaller rings keep the dump proportioned without more knobs
+        self._dispatches: deque = deque(maxlen=ring)
+        self._heartbeats: deque = deque(maxlen=max(ring // 4, 16))
+        self._events: deque = deque(maxlen=max(ring // 4, 16))
+        self._run_id = ""
+        self._dumped = False
+
+    # -- feeding ----------------------------------------------------------------
+
+    def begin_run(self, run_id: str) -> None:
+        with self._lock:
+            self._dispatches.clear()
+            self._heartbeats.clear()
+            self._events.clear()
+            self._run_id = run_id
+            self._dumped = False
+
+    def observe(self, kind: str, rec: Dict[str, Any]) -> None:
+        """Mirror one sink record (already schema-stamped fields) into the
+        matching ring. Unknown kinds ride the event ring — a future record
+        kind must not silently vanish from the forensics artifact."""
+        entry = {"schema": SCHEMA_VERSION, "kind": kind,
+                 "t": round(time.time(), 3), **rec}
+        with self._lock:
+            if kind == "heartbeat":
+                self._heartbeats.append(entry)
+            else:
+                self._events.append(entry)
+
+    def note_dispatch(self, global_step: int, real: int,
+                      dispatch_s: float, wait_s: float) -> None:
+        """One tiny record per dispatch round — the finest-grained trace of
+        what the run was doing right before death (heartbeats are 1-in-N)."""
+        with self._lock:
+            self._dispatches.append({
+                "t": round(time.time(), 3), "step": int(global_step),
+                "real": int(real), "dispatch_s": round(dispatch_s, 6),
+                "wait_s": round(wait_s, 6)})
+
+    # -- dumping ----------------------------------------------------------------
+
+    @staticmethod
+    def exception_cause(exc: BaseException) -> dict:
+        return {
+            "kind": "exception",
+            "type": type(exc).__name__,
+            "message": str(exc)[:2000],
+            # last 20 frames: enough to place the death, bounded on purpose
+            "traceback": traceback.format_exception(
+                type(exc), exc, exc.__traceback__)[-20:],
+        }
+
+    @staticmethod
+    def signal_cause(signum: int) -> dict:
+        import signal as _signal
+        try:
+            name = _signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        return {"kind": "signal", "signal": name, "signum": int(signum)}
+
+    def dump(self, cause: Optional[dict] = None,
+             extra: Optional[dict] = None) -> Optional[str]:
+        """Write the dump document atomically; returns the path, or None on
+        failure (best-effort like the sink — forensics must never mask the
+        original failure). Idempotent per run: the first cause wins (a
+        SIGTERM dump must not be overwritten by the KeyboardInterrupt-style
+        unwind that may follow it)."""
+        with self._lock:
+            if self._dumped:
+                return self.path
+            self._dumped = True
+            doc = {
+                "schema": SCHEMA_VERSION,
+                "kind": "blackbox",
+                "t": round(time.time(), 3),
+                "run_id": self._run_id,
+                "cause": cause or {"kind": "none"},
+                "heartbeats": list(self._heartbeats),
+                "events": list(self._events),
+                "dispatches": list(self._dispatches),
+            }
+        if extra:
+            doc.update(extra)
+        tmp = f"{self.path}.tmp-{os.getpid()}"
+        try:
+            from glint_word2vec_tpu.obs.sink import TelemetrySink
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(TelemetrySink._sanitize(doc), f, allow_nan=False)
+            os.replace(tmp, self.path)
+        except (OSError, TypeError, ValueError) as e:
+            logger.warning("blackbox dump failed: %s (the run's original "
+                           "failure is unaffected)", e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return None
+        logger.warning("blackbox dump written: %s (%d heartbeats, %d events, "
+                       "%d dispatch records)", self.path,
+                       len(doc["heartbeats"]), len(doc["events"]),
+                       len(doc["dispatches"]))
+        return self.path

@@ -14,7 +14,9 @@ Run as a CLI (the CI schema-validation step)::
     python -m glint_word2vec_tpu.obs.schema run.jsonl [more.jsonl ...]
 
 Prints one JSON summary line on stdout; exit code 0 iff every record of
-every file validates.
+every file validates. Paths ending ``.blackbox.json`` are validated as
+flight-recorder dumps (one JSON document whose ring entries reuse this
+catalogue — obs/blackbox.py) instead of as JSONL.
 """
 
 from __future__ import annotations
@@ -47,7 +49,8 @@ KINDS: Dict[str, Dict[str, tuple]] = {
         "pairs_per_sec": _NUM,
         "host_wait_s": _NUM,     # host-side wait since the previous heartbeat
         "dispatch_s": _NUM,      # dispatch time since the previous heartbeat
-        # optional: "norms" (the probe channel dict) when the probe ran
+        # optional fields (see KINDS_OPTIONAL): "norms", "phases",
+        # "recoveries", "lr_scale"
     },
     "watchdog": {
         "step": (int,),
@@ -85,6 +88,45 @@ KINDS: Dict[str, Dict[str, tuple]] = {
 
 _COMMON = {"schema": (int,), "kind": (str,), "t": _NUM}
 
+# OPTIONAL fields: type-checked when present, never required — this is what
+# "additive fields are free" means in practice. Round 13 added
+# recoveries/lr_scale/phases here, NOT to the required table: every new
+# writer emits them on every heartbeat (tests pin that), but archived v1
+# logs (CI artifacts, old remote-run JSONLs) must keep validating — making
+# a new field REQUIRED under an unchanged version number would retroactively
+# invalidate every file the previous release wrote.
+KINDS_OPTIONAL: Dict[str, Dict[str, tuple]] = {
+    "heartbeat": {
+        "norms": (dict,),        # probe channels, when the probe ran
+        "recoveries": (int,),    # recoveries performed so far this fit
+        "lr_scale": _NUM,        # effective lr multiplier the heartbeat's
+                                 # chunk actually DISPATCHED under
+        "phases": (dict,),       # per-phase log2 duration histograms over
+                                 # this heartbeat window (obs/phases.py)
+    },
+    "run_end": {
+        "phases": (dict,),       # cumulative per-phase rollup
+        "spans": (dict,),        # tracer span summary
+    },
+}
+
+# The flight-recorder dump (obs/blackbox.py, `<telemetry_path>.blackbox.json`)
+# is ONE JSON document, not JSONL — its ring entries reuse the record kinds
+# above, so the same catalogue validates both artifacts. Top-level required
+# fields; `cause.kind` enumerates the terminal-record variants.
+BLACKBOX_FIELDS: Dict[str, tuple] = {
+    "run_id": (str,),
+    "cause": (dict,),
+    "heartbeats": (list,),
+    "events": (list,),
+    "dispatches": (list,),
+}
+_CAUSE_KINDS = ("exception", "signal", "none")
+_DISPATCH_FIELDS: Dict[str, tuple] = {
+    "t": _NUM, "step": (int,), "real": (int,),
+    "dispatch_s": _NUM, "wait_s": _NUM,
+}
+
 
 def validate_record(rec: Any) -> List[str]:
     """Errors for one parsed record; empty list = valid."""
@@ -111,7 +153,86 @@ def validate_record(rec: Any) -> List[str]:
                 isinstance(rec[field], bool) and bool not in types):
             errs.append(f"{kind}.{field} has type {type(rec[field]).__name__}, "
                         f"expected {'/'.join(t.__name__ for t in types)}")
+    for field, types in KINDS_OPTIONAL.get(kind, {}).items():
+        if field in rec and rec[field] is not None and (
+                not isinstance(rec[field], types)
+                or (isinstance(rec[field], bool) and bool not in types)):
+            errs.append(f"{kind}.{field} has type {type(rec[field]).__name__}, "
+                        f"expected {'/'.join(t.__name__ for t in types)} "
+                        f"(optional field: absent is fine, wrong type is not)")
     return errs
+
+
+def validate_blackbox(doc: Any) -> List[str]:
+    """Errors for one parsed blackbox dump document; empty list = valid.
+    Ring entries are validated against the record catalogue above (they are
+    the same records the sink wrote), dispatch records against their own
+    field table, and the terminal ``cause`` against the variant enum."""
+    if not isinstance(doc, dict):
+        return [f"document is {type(doc).__name__}, not an object"]
+    errs: List[str] = []
+    if doc.get("kind") != "blackbox":
+        errs.append(f"kind is {doc.get('kind')!r}, expected 'blackbox'")
+    if doc.get("schema") != SCHEMA_VERSION:
+        errs.append(f"schema version {doc.get('schema')!r} != "
+                    f"{SCHEMA_VERSION}")
+    for field, types in BLACKBOX_FIELDS.items():
+        if field not in doc:
+            errs.append(f"missing field {field!r}")
+        elif not isinstance(doc[field], types):
+            errs.append(f"{field!r} has type {type(doc[field]).__name__}")
+    if errs:
+        return errs
+    cause = doc["cause"]
+    ck = cause.get("kind")
+    if ck not in _CAUSE_KINDS:
+        errs.append(f"cause.kind {ck!r} not in {_CAUSE_KINDS}")
+    elif ck == "exception" and not (
+            isinstance(cause.get("type"), str)
+            and isinstance(cause.get("message"), str)):
+        errs.append("exception cause needs string 'type' and 'message'")
+    elif ck == "signal" and not isinstance(cause.get("signal"), str):
+        errs.append("signal cause needs a string 'signal' name")
+    for i, rec in enumerate(doc["heartbeats"]):
+        for e in validate_record(rec):
+            errs.append(f"heartbeats[{i}]: {e}")
+        if isinstance(rec, dict) and rec.get("kind") != "heartbeat":
+            errs.append(f"heartbeats[{i}]: kind {rec.get('kind')!r}")
+    for i, rec in enumerate(doc["events"]):
+        for e in validate_record(rec):
+            errs.append(f"events[{i}]: {e}")
+    for i, rec in enumerate(doc["dispatches"]):
+        if not isinstance(rec, dict):
+            errs.append(f"dispatches[{i}]: not an object")
+            continue
+        for field, types in _DISPATCH_FIELDS.items():
+            if field not in rec:
+                errs.append(f"dispatches[{i}]: missing {field!r}")
+            elif not isinstance(rec[field], types) or isinstance(
+                    rec[field], bool):
+                errs.append(f"dispatches[{i}].{field} has type "
+                            f"{type(rec[field]).__name__}")
+    return errs
+
+
+def validate_blackbox_file(path: str, max_errors: int = 20) -> Dict[str, Any]:
+    """Validate one ``.blackbox.json`` dump; same summary shape as
+    :func:`validate_file` so the CLI handles both artifact kinds."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"path": path, "records": 0, "kinds": {}, "ok": False,
+                "errors": [f"{path}: unreadable ({e})"]}
+    errors = [f"{path}: {e}" for e in validate_blackbox(doc)]
+    kinds = {}
+    if not errors:
+        kinds = {"blackbox": 1,
+                 "heartbeat": len(doc["heartbeats"]),
+                 "event": len(doc["events"]),
+                 "dispatch": len(doc["dispatches"])}
+    return {"path": path, "records": 1 if not errors else 0, "kinds": kinds,
+            "ok": not errors, "errors": errors[:max_errors]}
 
 
 def validate_file(path: str, max_errors: int = 20) -> Dict[str, Any]:
@@ -148,7 +269,8 @@ def main(argv: List[str]) -> int:
                                      "glint_word2vec_tpu.obs.schema "
                                      "FILE.jsonl [...]"]}))
         return 2
-    results = [validate_file(p) for p in argv]
+    results = [validate_blackbox_file(p) if p.endswith(".blackbox.json")
+               else validate_file(p) for p in argv]
     ok = all(r["ok"] for r in results) and all(
         r["records"] > 0 for r in results)
     print(json.dumps({"ok": ok, "schema": SCHEMA_VERSION, "files": results}))

@@ -50,7 +50,11 @@ class TelemetrySink:
         self.path = path
         self.rotate_bytes = int(rotate_bytes)
         self.keep = int(keep)
-        self._lock = threading.Lock()
+        # RLock: the flight recorder's SIGTERM path emits the run_end
+        # record from the main-thread signal handler — a plain Lock held by
+        # that same thread's interrupted emit() would deadlock the handler
+        # (obs/blackbox.py has the full rationale)
+        self._lock = threading.RLock()
         self._file = None
         self._size = 0
         self._dead = False

@@ -44,6 +44,18 @@ class _NoopSpan:
 
 _NOOP = _NoopSpan()
 
+# span name → time-attribution phase (obs/phases.py): every recorded span
+# whose name maps here also lands in the accumulator attached for the run,
+# so the phase histograms need no second clock read at the span sites
+_PHASE_OF = {
+    "producer_wait": "producer_wait",
+    "stage_put": "stage",
+    "allgather_fetch": "stage",
+    "dispatch": "dispatch",
+    "health_probe": "device_block",
+    "device_block": "device_block",
+}
+
 
 class _Span:
     __slots__ = ("_tracer", "name", "args", "_t0")
@@ -70,15 +82,24 @@ class Tracer:
         from collections import deque
         self.enabled = enabled
         self.max_events = int(max_events)
-        self._lock = threading.Lock()
+        # RLock: the flight recorder's SIGTERM dump (main thread) reads
+        # span_summary() — a plain Lock held by the interrupted thread's
+        # own _record() would deadlock the handler (obs/blackbox.py)
+        self._lock = threading.RLock()
         # deque(maxlen): appending past capacity drops the OLDEST in O(1) —
         # the tail of a long run is what a hang/slowdown investigation needs
         self._events: "deque" = deque(maxlen=self.max_events)
         self._dropped = 0
         self._epoch = time.perf_counter()
+        self._phases = None  # PhaseAccumulator of the running trainer, or None
 
     def configure(self, enabled: bool) -> None:
         self.enabled = enabled
+
+    def attach_phases(self, acc) -> None:
+        """Attach (or detach with None) the run's PhaseAccumulator — recorded
+        spans whose names map to a phase tee their duration into it."""
+        self._phases = acc
 
     def clear(self) -> None:
         with self._lock:
@@ -114,6 +135,10 @@ class Tracer:
 
     def _record(self, name: str, t0: float, dur: float,
                 args: Optional[dict]) -> None:
+        if self._phases is not None:
+            phase = _PHASE_OF.get(name)
+            if phase is not None:
+                self._phases.add(phase, dur)
         ev = (name, threading.get_ident(), threading.current_thread().name,
               t0 - self._epoch, dur, args)
         with self._lock:

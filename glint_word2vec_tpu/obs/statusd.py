@@ -1,0 +1,150 @@
+"""Live run inspection: a read-only HTTP status endpoint for one trainer.
+
+``config.status_port > 0`` starts this server for the duration of a fit
+(trainer._start_run_bookkeeping → trainer._end_run), so an operator can ask
+a live remote trainer what it is doing — global step, pairs/s, effective
+lr, norm channels, recoveries, per-phase time histograms — without
+attaching a debugger or waiting for the run log to flush. Off by default
+and ZERO-cost when off: no thread is created and no socket is bound
+(tested in tests/test_statusd.py).
+
+Routes (GET only — the server mutates nothing):
+
+- ``/`` or ``/status.json`` — the full gauge snapshot as JSON (the same
+  dict ``Trainer.status_snapshot()`` returns);
+- ``/metrics`` — the scalar gauges in Prometheus text exposition format
+  (``glint_*`` names, docs/observability.md has the table);
+- ``/healthz`` — ``200 ok`` (liveness for scrapers).
+
+Design constraints:
+
+- read-only and single-threaded: one ``HTTPServer`` served from one daemon
+  thread (graftlint R1 documented owner — it only READS trainer state, so
+  the worker-count determinism contract is untouched); requests are
+  answered serially, which is exactly right for a human + one scraper;
+- snapshots are built by the serving thread from a callable the trainer
+  provides; the callable reads plain Python attributes and bounded rings
+  (GIL-consistent) — it never touches device state, so a scrape can never
+  interleave a collective into the dispatch pipeline;
+- binds ``127.0.0.1`` only: the endpoint is an operator tool, not a
+  service — remote scraping goes through a tunnel or a real exporter.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Callable, Optional
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+
+def prometheus_text(snap: dict) -> str:
+    """Render a status snapshot's scalar gauges in Prometheus text format.
+
+    Names/labels (stable contract, docs/observability.md): scalar fields
+    become ``glint_<field>``; per-matrix norm channels become
+    ``glint_norm_<channel>{matrix="syn0"|"syn1"}``; per-phase rollups become
+    ``glint_phase_seconds_total{phase=...}`` / ``glint_phase_count{phase=...}``.
+    """
+    lines = []
+
+    def gauge(name: str, value, labels: str = "") -> None:
+        if value is None or isinstance(value, bool):
+            value = float(bool(value)) if isinstance(value, bool) else None
+        if value is None:
+            return
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {float(value):g}")
+
+    for field in ("global_step", "words", "pairs_trained", "pairs_per_sec",
+                  "alpha", "lr_scale", "recoveries", "rollbacks",
+                  "watchdog_fires", "heartbeats", "host_wait_s_total",
+                  "dispatch_s_total"):
+        gauge(f"glint_{field}", snap.get(field))
+    gauge("glint_running", 1.0 if snap.get("status") == "running" else 0.0)
+    norms = snap.get("norms") or {}
+    for matrix in ("syn0", "syn1"):
+        ch = norms.get(matrix) or {}
+        for channel in ("max_norm", "mean_norm", "p99_norm", "frac_over"):
+            if channel in ch:
+                gauge(f"glint_norm_{channel}", ch[channel],
+                      f'{{matrix="{matrix}"}}')
+    for phase, ph in (snap.get("phases") or {}).items():
+        gauge("glint_phase_seconds_total", ph.get("total_s"),
+              f'{{phase="{phase}"}}')
+        gauge("glint_phase_count", ph.get("count"), f'{{phase="{phase}"}}')
+        gauge("glint_phase_p99_seconds", ph.get("p99_s"),
+              f'{{phase="{phase}"}}')
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set per-server via the factory in StatusServer.start
+    snapshot_fn: Callable[[], dict]
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/", "/status.json"):
+                body = json.dumps(self.snapshot_fn()).encode()
+                self._send(200, body, "application/json")
+            elif path == "/metrics":
+                body = prometheus_text(self.snapshot_fn()).encode()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                self._send(200, b"ok\n", "text/plain")
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-response — nothing to do
+
+    def log_message(self, fmt: str, *args) -> None:
+        logger.debug("statusd: %s", fmt % args)
+
+
+class StatusServer:
+    """One localhost HTTP server serving a snapshot callable, read-only."""
+
+    def __init__(self, port: int, snapshot_fn: Callable[[], dict]):
+        self._requested_port = int(port)
+        self._snapshot_fn = snapshot_fn
+        self._server: Optional[HTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        """The actually bound port (== requested unless requested was 0 —
+        tests use 0 for an ephemeral port; config refuses 0 as 'on')."""
+        return self._server.server_address[1] if self._server else 0
+
+    def start(self) -> "StatusServer":
+        handler = type("_BoundHandler", (_Handler,),
+                       {"snapshot_fn": staticmethod(self._snapshot_fn)})
+        self._server = HTTPServer(("127.0.0.1", self._requested_port), handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="glint-statusd",
+            daemon=True)
+        self._thread.start()
+        logger.info("statusd listening on 127.0.0.1:%d "
+                    "(/status.json, /metrics, /healthz)", self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

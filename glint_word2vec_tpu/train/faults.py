@@ -14,6 +14,10 @@ tests; all off by default and zero-cost when off):
 
 - ``GLINT_FAULT_CRASH_AT_STEP=N`` — SIGKILL this process at the end of the
   dispatch round that reaches global step >= N (trainer._finish_round).
+  ``GLINT_FAULT_CRASH_SIGNAL=TERM|INT|KILL`` (default KILL) picks the
+  signal: TERM is the catchable graceful-kill first warning a preemption
+  sends, the path the flight recorder's dump-on-SIGTERM hook rides
+  (obs/blackbox.py; chaos phase ``blackbox``).
 - ``GLINT_FAULT_CRASH_POINT=name[@k]`` — SIGKILL at the k-th (default first)
   pass through the named crash point. Checkpoint saves expose
   ``save:arrays-written`` (data files staged, no metadata yet),
@@ -77,6 +81,17 @@ class FaultPlan:
     """One scripted fault schedule. All zeros/empties = no faults."""
 
     crash_at_step: int = 0
+    crash_signal: str = "KILL"     # which signal the crash points send to
+                                   # self. "KILL" (default): the OOM/
+                                   # preemption-hard surface — no finally,
+                                   # no handlers, nothing flushes. "TERM":
+                                   # the graceful-kill FIRST WARNING a k8s
+                                   # eviction/preemption sends — catchable,
+                                   # so the flight-recorder SIGTERM hook
+                                   # (obs/blackbox.py) can be chaos-tested
+                                   # end-to-end. "INT": delivered as
+                                   # KeyboardInterrupt through the abort
+                                   # path
     crash_point: str = ""          # e.g. "save:swap" or "save:swap@2"
     corrupt_checkpoint_bytes: int = 0
     fail_ingest_first_n: int = 0
@@ -143,6 +158,7 @@ def active_plan() -> FaultPlan:
         return _override
     return FaultPlan(
         crash_at_step=_env_int("GLINT_FAULT_CRASH_AT_STEP"),
+        crash_signal=os.environ.get("GLINT_FAULT_CRASH_SIGNAL", "KILL"),
         crash_point=os.environ.get("GLINT_FAULT_CRASH_POINT", ""),
         corrupt_checkpoint_bytes=_env_int("GLINT_FAULT_CORRUPT_CKPT_BYTES"),
         fail_ingest_first_n=_env_int("GLINT_FAULT_FAIL_INGEST_FIRST_N"),
@@ -156,10 +172,17 @@ def active_plan() -> FaultPlan:
 
 
 def _crash_now(reason: str) -> None:
-    # stderr directly (not logging): handlers may buffer, and the point of the
-    # exercise is that nothing after this line runs
-    os.write(2, f"[glint-fault] SIGKILL: {reason}\n".encode())
-    os.kill(os.getpid(), signal.SIGKILL)
+    # stderr directly (not logging): handlers may buffer, and under the
+    # default SIGKILL nothing after this line runs. A scripted crash_signal
+    # of TERM/INT instead exercises the CATCHABLE-death surface (the
+    # graceful first warning a preemption sends) — the flight recorder's
+    # dump-on-SIGTERM hook is chaos-tested through exactly this path.
+    sig = {"KILL": signal.SIGKILL, "TERM": signal.SIGTERM,
+           "INT": signal.SIGINT}.get(
+        active_plan().crash_signal.upper(), signal.SIGKILL)
+    os.write(2, f"[glint-fault] SIG{signal.Signals(sig).name[3:]}: "
+                f"{reason}\n".encode())
+    os.kill(os.getpid(), sig)
 
 
 def crash_at_step(global_step: int) -> None:

@@ -93,6 +93,15 @@ class HeartbeatRecord:
                                    # update_mag (delta of mean_norm between
                                    # consecutive probes — a cheap update-
                                    # magnitude proxy needing no extra pass)
+    # --- mid-run recovery state (round 13): before this, only run_start/
+    # run_end carried them — telemetry_tail and the blackbox had to replay
+    # the whole sink file to know whether a live run had already recovered
+    recoveries: int = 0            # recoveries performed so far this fit
+    lr_scale: float = 1.0          # effective lr multiplier this heartbeat's
+                                   # chunk actually DISPATCHED under
+    phases: Optional[dict] = None  # per-phase log2 duration histograms over
+                                   # this heartbeat window (obs/phases.py)
+                                   # when time attribution is armed
 
 
 class _threaded_iter:
@@ -494,14 +503,35 @@ class Trainer:
             self._telemetry = TelemetrySink(
                 config.telemetry_path,
                 rotate_bytes=config.telemetry_rotate_bytes)
+        # flight recorder (obs/blackbox.py): exists only with telemetry on —
+        # the dump path derives from telemetry_path. Feeding it is a deque
+        # append per dispatch round; the dump itself only runs on fit death.
+        self._blackbox = None
+        if self._telemetry is not None:
+            from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+            self._blackbox = FlightRecorder(
+                config.telemetry_path + ".blackbox.json",
+                config.blackbox_ring)
+        # per-phase host time attribution (obs/phases.py): armed whenever
+        # anything consumes it — the sink (heartbeat/run_end rollups) or the
+        # live status endpoint. Disabled adds cost one attribute check.
+        from glint_word2vec_tpu.obs.phases import PhaseAccumulator
+        observing = self._telemetry is not None or config.status_port > 0
+        self._phases = PhaseAccumulator(enabled=observing)
+        self._statusd = None                 # obs/statusd.py, fit-scoped
+        self._prev_sigterm = None            # saved handler while fit runs
+        self._sigterm_installed = False      # see _install_run_signals
         # arm (or DISARM) the process-wide tracer for this trainer — at
         # construction, not only at fit start: the fit paths build their feed
         # iterators before _start_run_bookkeeping runs, and the producer
         # spans must observe the right state from the start. Disarming
         # matters as much as arming: a telemetry-off trainer after a
         # telemetry-on one in the same process (the overhead A/B's off arm)
-        # must not keep recording spans into the shared ring.
-        self._tracer.configure(enabled=self._telemetry is not None)
+        # must not keep recording spans into the shared ring. The phase
+        # accumulator attaches under the same rule (spans tee durations into
+        # it — obs/spans.py _PHASE_OF).
+        self._tracer.configure(enabled=observing)
+        self._tracer.attach_phases(self._phases if observing else None)
         self.norm_watchdog = NormWatchdog(
             config.norm_watch, config.norm_watch_threshold,
             config.norm_watch_max, config.norm_watch_frac)
@@ -1176,7 +1206,8 @@ class Trainer:
         device-inclusive, which that backend never reported honestly
         anyway."""
         if self._sync_collectives:
-            jax.block_until_ready(self.params)
+            with self._tracer.span("device_block"):
+                jax.block_until_ready(self.params)
 
     def _dispatch_step_fn(self, max_steps: int) -> Callable:
         """The step function for the NEXT dispatch: the fast (metrics-elided)
@@ -1342,7 +1373,9 @@ class Trainer:
             while True:
                 t0 = time.perf_counter()
                 chunk = next(chunks, None)
-                self.host_wait_time += time.perf_counter() - t0
+                wait = time.perf_counter() - t0
+                self.host_wait_time += wait
+                self._phases.add("producer_wait", wait)
                 if chunk is None:
                     break
                 t0 = time.perf_counter()
@@ -1767,7 +1800,9 @@ class Trainer:
             while True:
                 t0 = time.perf_counter()
                 chunk = next(chunks, None)
-                self.host_wait_time += time.perf_counter() - t0
+                wait = time.perf_counter() - t0
+                self.host_wait_time += wait
+                self._phases.add("producer_wait", wait)
                 if chunk is None:
                     break
                 t0 = time.perf_counter()
@@ -2084,7 +2119,9 @@ class Trainer:
                     t0 = time.perf_counter()
                     held = next(chunks, None)
                     if not staged:
-                        self.host_wait_time += time.perf_counter() - t0
+                        wait = time.perf_counter() - t0
+                        self.host_wait_time += wait
+                        self._phases.add("producer_wait", wait)
                     if held is None:
                         exhausted = True
                 offer = held if held is not None else dict(
@@ -2204,7 +2241,11 @@ class Trainer:
                 t0 = time.perf_counter()
                 rnd = next(rounds_it, None)
                 if staged:
-                    self.host_wait_time += time.perf_counter() - t0
+                    # unstaged, the wait IS the round assembly — its stage/
+                    # dispatch splits are attributed inside round_stream
+                    wait = time.perf_counter() - t0
+                    self.host_wait_time += wait
+                    self._phases.add("producer_wait", wait)
                 if rnd is None:
                     break
                 t0 = time.perf_counter()
@@ -2353,11 +2394,25 @@ class Trainer:
         import os
         self._run_ended = False
         self._run_id = f"{os.getpid()}-{int(time.time())}-{self.global_step}"
-        self._tracer.configure(enabled=self._telemetry is not None)
+        observing = self._telemetry is not None or self.config.status_port > 0
+        self._tracer.configure(enabled=observing)
+        self._phases.clear()
+        self._tracer.attach_phases(self._phases if observing else None)
+        self._last_hb_phases = self._phases.raw_snapshot()
+        # per-round marks for the flight recorder's dispatch ring
+        self._bb_wait_mark = 0.0
+        self._bb_disp_mark = 0.0
+        if self._blackbox is not None:
+            self._blackbox.begin_run(self._run_id)
+        self._install_run_signals()
+        if self.config.status_port and self._statusd is None:
+            from glint_word2vec_tpu.obs.statusd import StatusServer
+            self._statusd = StatusServer(
+                self.config.status_port, self.status_snapshot).start()
         if self._telemetry is not None:
             self._tracer.clear()
             cfg = self.config
-            self._telemetry.emit(
+            self._emit(
                 "run_start", run_id=self._run_id, vocab_size=self.vocab.size,
                 mesh=[self.plan.num_data, self.plan.num_model],
                 config={k: getattr(cfg, k) for k in (
@@ -2541,13 +2596,13 @@ class Trainer:
             reason = self.norm_watchdog.check(channels, self.global_step)
         except NormBlowupError:
             if self._telemetry is not None:
-                self._telemetry.emit(
+                self._emit(
                     "watchdog", step=self.global_step, policy="halt",
                     reason=self.norm_watchdog.last_reason or "",
                     channels=channels)
             raise
         if reason and self._telemetry is not None:
-            self._telemetry.emit(
+            self._emit(
                 "watchdog", step=self.global_step,
                 policy=self.config.norm_watch, reason=reason,
                 channels=channels)
@@ -2583,7 +2638,7 @@ class Trainer:
         def emit(action: str, snap_step: int, lr_scale: float,
                  clamp: float) -> None:
             if self._telemetry is not None:
-                self._telemetry.emit(
+                self._emit(
                     "recovery", step=self.global_step, action=action,
                     reason=reason, snapshot_step=snap_step,
                     recoveries_performed=self.recoveries_performed
@@ -2643,17 +2698,136 @@ class Trainer:
             (f", engaged max_row_norm={self._stabilizers.max_row_norm:g}"
              if engage_clamp else ""), reason)
 
+    def _emit(self, kind: str, **fields) -> None:
+        """One telemetry record to the sink AND the flight recorder's ring
+        (obs/blackbox.py) — single owner of record assembly, so the dump's
+        ring entries are byte-for-byte the records the JSONL carries."""
+        if self._telemetry is not None:
+            self._telemetry.emit(kind, **fields)
+        if self._blackbox is not None:
+            self._blackbox.observe(kind, fields)
+
+    def _install_run_signals(self) -> None:
+        """Arm the flight recorder's SIGTERM hook for the duration of fit():
+        SIGTERM is the first thing a preemption/k8s eviction sends and, unlike
+        SIGINT (delivered as KeyboardInterrupt, which the fit paths' abort
+        handler already turns into a dump), it would otherwise kill the
+        process with no artifact. Main-thread only (the signal module's
+        rule); restored by _teardown_run_inspection."""
+        if self._blackbox is None:
+            return
+        import signal
+        try:
+            # signal.signal returns the PRIOR handler — which is legally
+            # None when a non-Python (C-level) handler was installed, so a
+            # separate installed flag distinguishes "nothing to restore"
+            # from "prior handler unknown" (restored as SIG_DFL, best
+            # effort — leaving OUR handler installed would loop forever on
+            # the re-raise below)
+            self._prev_sigterm = signal.signal(
+                signal.SIGTERM, self._on_sigterm)
+            self._sigterm_installed = True
+        except ValueError:
+            self._sigterm_installed = False  # non-main-thread fit: no hook
+
+    def _on_sigterm(self, signum, frame) -> None:
+        import os
+        from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+        if self._blackbox is not None:
+            self._blackbox.dump(FlightRecorder.signal_cause(signum),
+                                extra=self._dump_context())
+        # _end_run's teardown RESTORES the pre-fit disposition (it must run
+        # before the re-raise, not after — nothing after os.kill runs under
+        # the default disposition), so the re-raised signal is delivered
+        # with the exit semantics the sender expects: SIG_DFL dies with
+        # rc = -SIGTERM, a framework's SIG_IGN/custom handler applies as if
+        # the fit had never hooked the signal
+        self._end_run("error")
+        os.kill(os.getpid(), signum)
+
+    def _teardown_run_inspection(self) -> None:
+        """Stop the fit-scoped status endpoint and restore the SIGTERM
+        disposition — idempotent, runs at every run end (ok or error,
+        including from inside the SIGTERM handler itself)."""
+        if self._statusd is not None:
+            self._statusd.stop()
+            self._statusd = None
+        if getattr(self, "_sigterm_installed", False):
+            import signal
+            self._sigterm_installed = False
+            signal.signal(
+                signal.SIGTERM,
+                self._prev_sigterm if self._prev_sigterm is not None
+                else signal.SIG_DFL)
+            self._prev_sigterm = None
+
+    def _dump_context(self) -> dict:
+        """The at-death snapshots the flight-recorder dump carries beside the
+        rings: where the time went, what the spans saw, the live gauges."""
+        return {"phases": self._phases.summary(),
+                "spans": self._tracer.span_summary(),
+                "status": self.status_snapshot()}
+
+    def status_snapshot(self) -> dict:
+        """The live-inspection gauge snapshot (obs/statusd.py serves this as
+        /status.json and renders /metrics from it). Reads only plain host
+        attributes and bounded rings — never device state — so a scrape can
+        never interleave a collective into the dispatch pipeline."""
+        hb = self.heartbeats[-1] if self.heartbeats else None
+        return {
+            "run_id": getattr(self, "_run_id", ""),
+            "status": ("idle" if getattr(self, "_run_ended", True)
+                       else "running"),
+            "global_step": int(self.global_step),
+            "words": int(self.state.words_processed),
+            "pairs_trained": float(self.pairs_trained),
+            "pairs_per_sec": float(hb.pairs_per_sec) if hb else None,
+            "alpha": float(hb.alpha) if hb else None,
+            "lr_scale": float(self._lr_scale),
+            "recoveries": int(self.recoveries_performed),
+            "rollbacks": int(self.rollbacks_performed),
+            "watchdog_fires": int(self.norm_watchdog.fires),
+            "heartbeats": len(self.heartbeats),
+            "host_wait_s_total": round(
+                getattr(self, "host_wait_time", 0.0), 3),
+            "dispatch_s_total": round(
+                getattr(self, "dispatch_time", 0.0), 3),
+            "norms": self._last_probe_channels,
+            "phases": self._phases.summary(),
+        }
+
+    @property
+    def last_run_stats(self) -> dict:
+        """Runtime outcome of the last fit: the robustness end state the
+        EVAL harness emits into its rows, plus — when time attribution is
+        armed — the per-phase rollup, so "where did the time go" rides the
+        same surface as "did it recover"."""
+        stats = {
+            "watchdog_fires": int(self.norm_watchdog.fires),
+            "rollbacks_performed": int(self.rollbacks_performed),
+            "recoveries_performed": int(self.recoveries_performed),
+            "lr_scale_final": float(self._lr_scale),
+            "engaged_max_row_norm": float(self._stabilizers.max_row_norm),
+            "engaged_update_clip": float(self._stabilizers.update_clip),
+            "engaged_row_l2": float(self._stabilizers.row_l2),
+        }
+        phases = self._phases.summary()
+        if phases:
+            stats["phases"] = phases
+        return stats
+
     def _end_run(self, status: str) -> None:
         """Emit the run_end record + export the Chrome trace (idempotent per
         _start_run_bookkeeping). The success path calls this AFTER the final
         checkpoint save so that save's span lands in the exported trace; the
         error path reaches it through _finish_run_telemetry in the fit
         ``finally`` blocks."""
+        self._teardown_run_inspection()
         if getattr(self, "_run_ended", True):
             return
         self._run_ended = True
         if self._telemetry is not None:
-            self._telemetry.emit(
+            self._emit(
                 "run_end", run_id=self._run_id, status=status,
                 steps=int(self.global_step),
                 pairs_trained=float(self.pairs_trained),
@@ -2663,6 +2837,7 @@ class Trainer:
                 rollbacks=int(self.rollbacks_performed),
                 recoveries=int(self.recoveries_performed),
                 lr_scale=round(float(self._lr_scale), 9),
+                phases=self._phases.summary(),
                 spans=self._tracer.span_summary())
             try:
                 self.export_trace(self.config.telemetry_path + ".trace.json")
@@ -2686,9 +2861,21 @@ class Trainer:
         ``sys.exc_info()`` in the ``finally`` — because exc_info also
         reports an OUTER handled exception (fit() called inside an except
         block, e.g. the crash-recovery resume pattern) and would mislabel a
-        successful recovery fit as an error. The success path emits after
-        the final checkpoint save instead (see _end_run)."""
+        successful recovery fit as an error. (Reading exc_info HERE is safe:
+        this method only runs inside the except clause, where it is by
+        construction the in-flight exception.) The success path emits after
+        the final checkpoint save instead (see _end_run). Dumps the flight
+        recorder LAST, after run_end — so the dump's event ring carries the
+        terminal run_end record too."""
+        import sys
+        exc = sys.exc_info()[1]
         self._end_run("error")
+        if self._blackbox is not None:
+            from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+            self._blackbox.dump(
+                FlightRecorder.exception_cause(exc) if exc is not None
+                else None,
+                extra=self._dump_context())
 
     def _finish_round(
         self,
@@ -2716,6 +2903,15 @@ class Trainer:
         # heartbeat must not retroactively report the new scale for a chunk
         # trained at the old one
         lr_scale_at_dispatch = self._lr_scale
+        if self._blackbox is not None:
+            # one tiny record per round: the finest-grained trace of what the
+            # run was doing right before a death (heartbeats are 1-in-N)
+            self._blackbox.note_dispatch(
+                self.global_step, real,
+                self.dispatch_time - self._bb_disp_mark,
+                self.host_wait_time - self._bb_wait_mark)
+            self._bb_disp_mark = self.dispatch_time
+            self._bb_wait_mark = self.host_wait_time
 
         if faults.take_nan_injection(self.global_step):
             if self._poison_fn is None:
@@ -2782,8 +2978,17 @@ class Trainer:
             # disallows, reachable here only on heartbeat rounds (which the
             # audit's scripted fits are too short to hit; tests/test_obs.py
             # runs a probing fit under the guard to keep this path honest)
-            loss_k, fpos_k = jax.device_get(
-                (metrics.loss, metrics.mean_f_pos))
+            with self._tracer.span("device_block"):
+                loss_k, fpos_k = jax.device_get(
+                    (metrics.loss, metrics.mean_f_pos))
+            # per-phase attribution over THIS heartbeat window (obs/
+            # phases.py): delta of the accumulator the spans + wait sites
+            # have been feeding since the previous heartbeat
+            phases_window = None
+            if self._phases.enabled:
+                phases_window = self._phases.delta(
+                    self._last_hb_phases) or None
+                self._last_hb_phases = self._phases.raw_snapshot()
             rec = HeartbeatRecord(
                 words=self.state.words_processed,
                 # the EFFECTIVE lr: recovery backoff multiplies the
@@ -2795,7 +3000,10 @@ class Trainer:
                 global_step=self.global_step,
                 host_wait_s=self.host_wait_time - self._last_hb_host_wait,
                 dispatch_s=self.dispatch_time - self._last_hb_dispatch,
-                norms=channels)
+                norms=channels,
+                recoveries=self.recoveries_performed,
+                lr_scale=lr_scale_at_dispatch,
+                phases=phases_window)
             self._last_hb_host_wait = self.host_wait_time
             self._last_hb_dispatch = self.dispatch_time
             self.heartbeats.append(rec)
@@ -2804,14 +3012,17 @@ class Trainer:
                 "pairs/s = %.0f", rec.words, rec.alpha, rec.loss,
                 rec.mean_f_pos, rec.pairs_per_sec)
             if self._telemetry is not None:
-                self._telemetry.emit(
+                self._emit(
                     "heartbeat", step=rec.global_step, words=rec.words,
                     alpha=rec.alpha, loss=rec.loss,
                     mean_f_pos=rec.mean_f_pos,
                     pairs_per_sec=round(rec.pairs_per_sec, 3),
                     host_wait_s=round(rec.host_wait_s, 6),
                     dispatch_s=round(rec.dispatch_s, 6),
-                    **({"norms": channels} if channels is not None else {}))
+                    recoveries=int(rec.recoveries),
+                    lr_scale=round(float(rec.lr_scale), 9),
+                    **({"norms": channels} if channels is not None else {}),
+                    **({"phases": phases_window} if phases_window else {}))
             if on_heartbeat is not None:
                 on_heartbeat(rec)
             self._last_log_time, self._last_log_step = now, self.global_step
@@ -2992,7 +3203,9 @@ class Trainer:
             while True:
                 t0 = time.perf_counter()
                 local = None if exhausted else next(chunks, None)
-                self.host_wait_time += time.perf_counter() - t0
+                wait = time.perf_counter() - t0
+                self.host_wait_time += wait
+                self._phases.add("producer_wait", wait)
                 if local is None:
                     exhausted = True
                     local = dict(arrays=zero_arrays,

@@ -1,0 +1,308 @@
+"""Flight recorder + time attribution suite (docs/observability.md §5–6):
+phase histogram bucketing vs exact quantiles, the accumulator's window
+deltas and thread safety, the recorder's ring bounds / first-cause-wins
+dump, and the trainer integration — a dying fit (guardrail halt, watchdog
+halt) leaves a schema-valid ``.blackbox.json`` with the terminal cause
+while a clean fit leaves none, and heartbeats carry the mid-run recovery
+state (``recoveries``/``lr_scale``) the satellite added."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.pipeline import encode_sentences
+from glint_word2vec_tpu.data.vocab import build_vocab
+from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+from glint_word2vec_tpu.obs.phases import (
+    HIST_BUCKETS,
+    PhaseAccumulator,
+    bucket_index,
+    bucket_upper_edge,
+)
+from glint_word2vec_tpu.obs.schema import (
+    validate_blackbox,
+    validate_blackbox_file,
+)
+from glint_word2vec_tpu.train import faults
+from glint_word2vec_tpu.train.faults import (
+    NonFiniteParamsError,
+    NormBlowupError,
+)
+from glint_word2vec_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _toy_trainer(seed=0, n=250, **cfg_kw):
+    rng = np.random.default_rng(seed)
+    sents = [[f"w{i}" for i in rng.integers(0, 30, 20)] for _ in range(n)]
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=8, pairs_per_batch=128, window=3,
+                         num_iterations=2, steps_per_dispatch=2,
+                         heartbeat_every_steps=2, subsample_ratio=0.0,
+                         prefetch_chunks=0, seed=1, **cfg_kw)
+    return Trainer(cfg, vocab), encode_sentences(sents, vocab, 1000)
+
+
+# -- phase histograms ------------------------------------------------------------------
+
+
+def test_bucket_edges_bound_quantiles():
+    """A bucketed quantile must sit within one quarter-octave (ratio
+    <= 2^0.25) above the exact value — same contract as the probe's norm
+    histogram."""
+    rng = np.random.default_rng(0)
+    durations = 10.0 ** rng.uniform(-5, 0, 5000)  # 10 µs .. 1 s
+    acc = PhaseAccumulator(enabled=True)
+    for d in durations:
+        acc.add("dispatch", float(d))
+    s = acc.summary()["dispatch"]
+    for q, got in ((0.50, s["p50_s"]), (0.99, s["p99_s"])):
+        exact = float(np.quantile(durations, q))
+        assert exact <= got <= exact * 2 ** 0.25 * 1.001, (q, exact, got)
+    assert s["count"] == len(durations)
+    assert s["total_s"] == pytest.approx(durations.sum(), rel=1e-4)
+    assert s["max_s"] == pytest.approx(durations.max(), rel=1e-4)
+
+
+def test_bucket_index_clamps_and_orders():
+    assert bucket_index(0.0) == 0
+    assert bucket_index(1e-9) == 0
+    assert bucket_index(1e9) == HIST_BUCKETS - 1
+    idx = [bucket_index(x) for x in (1e-6, 1e-3, 0.1, 1.0, 10.0)]
+    assert idx == sorted(idx)
+    for i in range(HIST_BUCKETS - 1):
+        assert bucket_upper_edge(i) < bucket_upper_edge(i + 1)
+    # every duration lands at or below its bucket's upper edge
+    for d in (1e-5, 0.003, 0.7, 42.0):
+        assert d <= bucket_upper_edge(bucket_index(d)) * 1.001
+
+
+def test_accumulator_window_delta():
+    acc = PhaseAccumulator(enabled=True)
+    acc.add("stage", 0.01)
+    mark = acc.raw_snapshot()
+    acc.add("stage", 0.02)
+    acc.add("dispatch", 0.5)
+    delta = acc.delta(mark)
+    assert delta["stage"]["count"] == 1
+    assert delta["stage"]["total_s"] == pytest.approx(0.02, rel=1e-6)
+    assert delta["dispatch"]["count"] == 1
+    # cumulative summary still has both stage adds
+    assert acc.summary()["stage"]["count"] == 2
+    # an idle window deltas to empty
+    assert acc.delta(acc.raw_snapshot()) == {}
+
+
+def test_accumulator_disabled_and_unknown_phase_noop():
+    acc = PhaseAccumulator(enabled=False)
+    acc.add("dispatch", 1.0)
+    assert acc.summary() == {}
+    acc.configure(True)
+    acc.add("not_a_phase", 1.0)  # unknown phases are dropped, not KeyError
+    assert acc.summary() == {}
+
+
+def test_accumulator_thread_safe():
+    acc = PhaseAccumulator(enabled=True)
+
+    def add_many():
+        for _ in range(2000):
+            acc.add("producer_wait", 1e-4)
+
+    threads = [threading.Thread(target=add_many) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert acc.summary()["producer_wait"]["count"] == 8000
+
+
+# -- the recorder itself ---------------------------------------------------------------
+
+
+def test_recorder_rings_bounded_and_routed(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "bb.json"), ring=8)
+    rec.begin_run("r1")
+    for i in range(50):
+        rec.note_dispatch(i, 2, 0.01, 0.001)
+        rec.observe("heartbeat", {"step": i, "words": i, "alpha": 0.1,
+                                  "loss": 1.0, "mean_f_pos": 0.5,
+                                  "pairs_per_sec": 1.0, "host_wait_s": 0.0,
+                                  "dispatch_s": 0.0, "recoveries": 0,
+                                  "lr_scale": 1.0})
+    rec.observe("watchdog", {"step": 9, "policy": "warn", "reason": "x",
+                             "channels": {}})
+    path = rec.dump({"kind": "none"})
+    doc = json.load(open(path))
+    assert validate_blackbox(doc) == []
+    assert len(doc["dispatches"]) == 8          # ring bound
+    assert doc["dispatches"][-1]["step"] == 49  # newest kept
+    assert len(doc["heartbeats"]) == 16         # ring // 4 floor is 16
+    assert [e["kind"] for e in doc["events"]] == ["watchdog"]
+    # atomic: no tmp debris beside the dump
+    assert all(".tmp-" not in f for f in os.listdir(tmp_path))
+
+
+def test_recorder_first_cause_wins(tmp_path):
+    """A SIGTERM dump must not be overwritten by the unwind that follows."""
+    rec = FlightRecorder(str(tmp_path / "bb.json"))
+    rec.begin_run("r1")
+    rec.dump(FlightRecorder.signal_cause(15))
+    rec.dump(FlightRecorder.exception_cause(RuntimeError("later")))
+    doc = json.load(open(tmp_path / "bb.json"))
+    assert doc["cause"]["kind"] == "signal"
+    # a NEW run re-arms the dump
+    rec.begin_run("r2")
+    rec.dump(FlightRecorder.exception_cause(RuntimeError("second run")))
+    doc = json.load(open(tmp_path / "bb.json"))
+    assert doc["cause"] == {
+        "kind": "exception", "type": "RuntimeError",
+        "message": "second run",
+        "traceback": doc["cause"]["traceback"]}
+
+
+def test_validator_rejects_malformed_dump():
+    assert validate_blackbox([])  # not an object
+    base = {"schema": 1, "kind": "blackbox", "t": 1.0, "run_id": "r",
+            "cause": {"kind": "none"}, "heartbeats": [], "events": [],
+            "dispatches": []}
+    assert validate_blackbox(base) == []
+    assert validate_blackbox({**base, "cause": {"kind": "meteor"}})
+    assert validate_blackbox({**base, "dispatches": [{"t": 1.0}]})
+    assert validate_blackbox(
+        {**base, "heartbeats": [{"schema": 1, "kind": "watchdog", "t": 1.0}]})
+
+
+# -- trainer integration ---------------------------------------------------------------
+
+
+def test_nonfinite_halt_leaves_valid_dump(tmp_path):
+    """The guardrail's NonFiniteParamsError rides the abort path: the dump
+    must exist, validate, and carry the exception cause + the run_end
+    terminal record + ring contents."""
+    run_log = str(tmp_path / "run.jsonl")
+    faults.configure(nan_at_step=8)
+    trainer, enc = _toy_trainer(seed=2, telemetry_path=run_log)
+    with pytest.raises(NonFiniteParamsError):
+        trainer.fit(enc)
+    dump = run_log + ".blackbox.json"
+    v = validate_blackbox_file(dump)
+    assert v["ok"], v["errors"]
+    doc = json.load(open(dump))
+    assert doc["cause"]["kind"] == "exception"
+    assert doc["cause"]["type"] == "NonFiniteParamsError"
+    assert doc["run_id"]
+    assert len(doc["heartbeats"]) >= 1
+    assert len(doc["dispatches"]) >= 1
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "run_start" in kinds and "run_end" in kinds
+    assert doc["status"]["status"] == "idle"  # run_end ran before the dump
+    assert "phases" in doc and "spans" in doc
+
+
+def test_norm_blowup_halt_dump_carries_watchdog_record(tmp_path):
+    run_log = str(tmp_path / "run.jsonl")
+    faults.configure(scale_params_at_step=8)
+    trainer, enc = _toy_trainer(seed=2, telemetry_path=run_log,
+                                norm_watch="halt")
+    with pytest.raises(NormBlowupError):
+        trainer.fit(enc)
+    v = validate_blackbox_file(run_log + ".blackbox.json")
+    assert v["ok"], v["errors"]
+    doc = json.load(open(run_log + ".blackbox.json"))
+    assert doc["cause"]["type"] == "NormBlowupError"
+    assert "watchdog" in [e["kind"] for e in doc["events"]]
+
+
+def test_clean_run_leaves_no_dump_and_next_fit_rearms(tmp_path):
+    run_log = str(tmp_path / "run.jsonl")
+    trainer, enc = _toy_trainer(telemetry_path=run_log)
+    trainer.fit(enc)
+    assert not os.path.exists(run_log + ".blackbox.json")
+    # the same trainer dying on a LATER fit still dumps (per-run re-arm)
+    faults.configure(nan_at_step=trainer.global_step + 8)
+    trainer.state = type(trainer.state)()
+    with pytest.raises(NonFiniteParamsError):
+        trainer.fit(enc)
+    assert validate_blackbox_file(run_log + ".blackbox.json")["ok"]
+
+
+def test_telemetry_off_means_no_recorder_no_signal_hook():
+    import signal
+    before = signal.getsignal(signal.SIGTERM)
+    trainer, enc = _toy_trainer(n=60)
+    trainer.fit(enc)
+    assert trainer._blackbox is None
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_sigterm_disposition_restored_after_fit(tmp_path):
+    import signal
+    before = signal.getsignal(signal.SIGTERM)
+    trainer, enc = _toy_trainer(n=60, telemetry_path=str(tmp_path / "r.jsonl"))
+    trainer.fit(enc)
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_heartbeat_carries_recovery_state(tmp_path):
+    """Satellite: recoveries + the effective lr scale ride EVERY heartbeat
+    (pre-round-13 only run_start/run_end had them), so the tail/blackbox can
+    show mid-run recovery state without replaying the file."""
+    run_log = str(tmp_path / "run.jsonl")
+    faults.configure(scale_params_at_step=8)
+    trainer, enc = _toy_trainer(seed=2, telemetry_path=run_log,
+                                norm_watch="recover")
+    trainer.fit(enc)
+    assert trainer.recoveries_performed >= 1
+    hbs = [json.loads(line) for line in open(run_log)
+           if json.loads(line)["kind"] == "heartbeat"]
+    assert all("recoveries" in h and "lr_scale" in h for h in hbs)
+    assert hbs[0]["recoveries"] == 0 and hbs[0]["lr_scale"] == 1.0
+    post = [h for h in hbs if h["recoveries"] >= 1]
+    assert post, "no heartbeat after the recovery"
+    assert post[-1]["lr_scale"] == pytest.approx(
+        trainer._lr_scale, rel=1e-6)
+    # the in-memory ring mirrors the sink fields
+    assert trainer.heartbeats[-1].recoveries == trainer.recoveries_performed
+
+
+def test_run_telemetry_carries_phase_attribution(tmp_path):
+    """Tentpole layer 2 e2e: heartbeats carry window deltas, run_end the
+    cumulative rollup, and Trainer.last_run_stats mirrors it — with the
+    producer_wait/dispatch phases populated on a real fit."""
+    run_log = str(tmp_path / "run.jsonl")
+    trainer, enc = _toy_trainer(telemetry_path=run_log)
+    trainer.fit(enc)
+    recs = [json.loads(line) for line in open(run_log)]
+    hb_phases = [r["phases"] for r in recs
+                 if r["kind"] == "heartbeat" and r.get("phases")]
+    assert hb_phases, "no heartbeat carried a phases window"
+    end = [r for r in recs if r["kind"] == "run_end"][-1]
+    for phase in ("producer_wait", "dispatch"):
+        assert phase in end["phases"], end["phases"].keys()
+        assert end["phases"][phase]["count"] > 0
+        assert end["phases"][phase]["hist"]
+    # windows sum to (at most) the cumulative counts
+    total_hb = sum(w.get("dispatch", {}).get("count", 0) for w in hb_phases)
+    assert total_hb <= end["phases"]["dispatch"]["count"]
+    stats = trainer.last_run_stats
+    assert stats["phases"]["dispatch"]["count"] == \
+        end["phases"]["dispatch"]["count"]
+
+
+def test_phases_zero_cost_when_observability_off():
+    trainer, enc = _toy_trainer(n=60)
+    trainer.fit(enc)
+    assert not trainer._phases.enabled
+    assert trainer._phases.summary() == {}
+    assert "phases" not in trainer.last_run_stats

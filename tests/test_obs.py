@@ -66,7 +66,8 @@ def test_sink_roundtrip_schema_valid(tmp_path):
                   config={"learning_rate": 0.02})
         sink.emit("heartbeat", step=4, words=100, alpha=0.02, loss=1.5,
                   mean_f_pos=0.4, pairs_per_sec=1e5, host_wait_s=0.1,
-                  dispatch_s=0.2, norms={"finite": True})
+                  dispatch_s=0.2, recoveries=0, lr_scale=1.0,
+                  norms={"finite": True})
         sink.emit("watchdog", step=4, policy="warn", reason="x",
                   channels={"syn0": {"max_norm": 1e4}})
         sink.emit("run_end", run_id="r1", status="ok", steps=4,
@@ -85,7 +86,8 @@ def test_sink_roundtrip_schema_valid(tmp_path):
 def test_schema_rejects_drift():
     ok = {"schema": SCHEMA_VERSION, "kind": "heartbeat", "t": 1.0, "step": 1,
           "words": 10, "alpha": 0.1, "loss": 1.0, "mean_f_pos": 0.5,
-          "pairs_per_sec": 1.0, "host_wait_s": 0.0, "dispatch_s": 0.0}
+          "pairs_per_sec": 1.0, "host_wait_s": 0.0, "dispatch_s": 0.0,
+          "recoveries": 0, "lr_scale": 1.0}
     assert validate_record(ok) == []
     assert validate_record({**ok, "schema": SCHEMA_VERSION + 1})  # version drift
     bad = dict(ok)
@@ -95,6 +97,15 @@ def test_schema_rejects_drift():
     assert validate_record({**ok, "kind": "mystery"})      # unknown kind
     # additive evolution stays legal
     assert validate_record({**ok, "new_field": 123}) == []
+    # a pre-round-13 heartbeat (no recoveries/lr_scale/phases) still
+    # validates — new fields are OPTIONAL under the unchanged version, so
+    # archived run logs don't retroactively fail the drift gate
+    old = dict(ok)
+    del old["recoveries"], old["lr_scale"]
+    assert validate_record(old) == []
+    # ...but a present optional field is still type-checked
+    assert any("lr_scale" in e
+               for e in validate_record({**ok, "lr_scale": "half"}))
 
 
 def test_sink_rotation_bounded(tmp_path):
@@ -144,7 +155,7 @@ def test_sink_sanitizes_nonfinite(tmp_path):
     with TelemetrySink(p) as sink:
         sink.emit("heartbeat", step=1, words=1, alpha=0.1, loss=float("nan"),
                   mean_f_pos=float("inf"), pairs_per_sec=1.0,
-                  host_wait_s=0.0, dispatch_s=0.0,
+                  host_wait_s=0.0, dispatch_s=0.0, recoveries=0, lr_scale=1.0,
                   norms={"syn0": {"max_norm": float("-inf")}})
     line = open(p).read()
     assert "NaN" not in line and "Infinity" not in line

@@ -1,0 +1,150 @@
+"""Driver-tool suite for the observability consumption half
+(docs/observability.md §7–8): telemetry_tail renders a run log for humans,
+run_report folds it into ONE machine JSON line (R7) and flags truncation,
+and perfgate's self-test proves the regression gate fires on a seeded
+regression while passing the genuine committed bench line."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def run_log(tmp_path_factory):
+    """One shared telemetry-on toy fit; returns the sink JSONL path."""
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.train.trainer import Trainer
+    path = str(tmp_path_factory.mktemp("telemetry") / "run.jsonl")
+    rng = np.random.default_rng(0)
+    sents = [[f"w{i}" for i in rng.integers(0, 30, 20)] for _ in range(250)]
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=8, pairs_per_batch=128, window=3,
+                         num_iterations=2, steps_per_dispatch=2,
+                         heartbeat_every_steps=2, subsample_ratio=0.0,
+                         prefetch_chunks=0, seed=1, telemetry_path=path)
+    Trainer(cfg, vocab).fit(encode_sentences(sents, vocab, 1000))
+    return path
+
+
+def _run(args, **kw):
+    return subprocess.run(
+        [sys.executable] + args, cwd=_REPO, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=300, **kw)
+
+
+# -- telemetry_tail --------------------------------------------------------------------
+
+
+def test_telemetry_tail_summarizes(run_log):
+    proc = _run([os.path.join(_REPO, "tools", "telemetry_tail.py"),
+                 run_log, "--last", "5"])
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "pairs/s: median" in out
+    assert "run_start=1" in out and "run_end=1" in out
+    assert "phase dispatch" in out  # the attribution windows render
+    assert "status ok" in out
+
+
+# -- run_report ------------------------------------------------------------------------
+
+
+def test_run_report_one_json_line(run_log):
+    proc = _run([os.path.join(_REPO, "tools", "run_report.py"), run_log])
+    assert proc.returncode == 0, proc.stderr
+    lines = [line for line in proc.stdout.splitlines() if line.strip()]
+    assert len(lines) == 1, "R7: exactly one stdout line"
+    rep = json.loads(lines[0])
+    assert rep["ok"] and rep["status"] == "ok" and rep["schema_valid"]
+    assert rep["heartbeats"] >= 1
+    assert rep["pairs_per_sec"]["median"] > 0
+    assert rep["phases"]["dispatch"]["count"] > 0
+    assert rep["norms"]["syn0"]["max"] > 0
+    assert rep["lr_scale_final"] == 1.0
+
+
+def test_run_report_flags_truncated_log(run_log, tmp_path):
+    """A log with no run_end is the crash signature — the report must say
+    'truncated' and exit nonzero so a remote driver can alarm on it."""
+    truncated = str(tmp_path / "trunc.jsonl")
+    with open(run_log) as src, open(truncated, "w") as dst:
+        for line in src:
+            if json.loads(line)["kind"] != "run_end":
+                dst.write(line)
+    proc = _run([os.path.join(_REPO, "tools", "run_report.py"), truncated])
+    assert proc.returncode == 1
+    rep = json.loads(proc.stdout.strip())
+    assert rep["status"] == "truncated" and not rep["ok"]
+    # steps + phases still reconstructed from the heartbeat windows
+    assert rep["steps"] > 0
+    assert rep["phases"].get("dispatch", {}).get("count", 0) > 0
+
+
+def test_run_report_folds_blackbox(run_log, tmp_path):
+    """--blackbox validates + embeds the dump's terminal cause."""
+    from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+    dump = str(tmp_path / "x.blackbox.json")
+    rec = FlightRecorder(dump)
+    rec.begin_run("r1")
+    rec.dump(FlightRecorder.signal_cause(15))
+    proc = _run([os.path.join(_REPO, "tools", "run_report.py"), run_log,
+                 "--blackbox", dump])
+    rep = json.loads(proc.stdout.strip())
+    assert rep["blackbox"]["valid"]
+    assert rep["blackbox"]["cause"]["signal"] == "SIGTERM"
+
+
+# -- perfgate --------------------------------------------------------------------------
+
+
+def test_perfgate_smoke_self_test():
+    """Acceptance: the genuine current bench line passes the tolerance
+    bands, the seeded regression fires — one JSON line, exit 0."""
+    proc = _run([os.path.join(_REPO, "tools", "perfgate.py"), "--smoke"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [line for line in proc.stdout.splitlines() if line.strip()]
+    assert len(lines) == 1, "R7: exactly one stdout line"
+    rep = json.loads(lines[0])
+    assert rep["ok"] and rep["mode"] == "smoke"
+    assert rep["genuine"]["ok"], rep["genuine"]
+    assert not rep["seeded"]["ok"]
+    assert "value" in rep["seeded"]["fired_on"]
+    assert len(rep["rungs"]) >= 2
+
+
+def test_perfgate_smoke_fails_if_seed_does_not_fire():
+    """seed-factor 1.0 = no regression seeded: the self-test must then FAIL
+    (a gate that can't fire is worse than no gate)."""
+    proc = _run([os.path.join(_REPO, "tools", "perfgate.py"), "--smoke",
+                 "--seed-factor", "1.0"])
+    assert proc.returncode == 1
+    rep = json.loads(proc.stdout.strip())
+    assert not rep["ok"] and rep["seeded"]["ok"]
+
+
+def test_perfgate_gates_a_fresh_bench_file(tmp_path):
+    """Real mode: an in-band fresh line passes, a regressed one fails, and
+    both accept the RAW bench.py line shape (no driver wrapper)."""
+    genuine = json.load(open(os.path.join(_REPO, "BENCH_r05.json")))["parsed"]
+    good = str(tmp_path / "good.json")
+    json.dump(genuine, open(good, "w"))
+    proc = _run([os.path.join(_REPO, "tools", "perfgate.py"),
+                 "--bench", good])
+    assert proc.returncode == 0, proc.stdout
+    assert json.loads(proc.stdout.strip())["ok"]
+
+    bad = str(tmp_path / "bad.json")
+    json.dump({**genuine, "value": genuine["value"] * 0.5}, open(bad, "w"))
+    proc = _run([os.path.join(_REPO, "tools", "perfgate.py"), "--bench", bad])
+    assert proc.returncode == 1
+    rep = json.loads(proc.stdout.strip())
+    assert not rep["metrics"]["value"]["ok"]
+    assert rep["metrics"]["e2e_pairs_per_sec"]["ok"]  # untouched metric holds

@@ -1,0 +1,143 @@
+"""Live-inspection endpoint suite (docs/observability.md §7): the server
+really serves JSON + Prometheus + healthz DURING a fit, is fit-scoped
+(stopped at run end), read-only (404 elsewhere, GET only), and — the
+acceptance contract — ZERO-cost when off: no thread created, no socket
+bound, no phase accumulator armed."""
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.data.pipeline import encode_sentences
+from glint_word2vec_tpu.data.vocab import build_vocab
+from glint_word2vec_tpu.obs.statusd import StatusServer, prometheus_text
+from glint_word2vec_tpu.train.trainer import Trainer
+
+
+def _toy_trainer(seed=0, n=250, **cfg_kw):
+    rng = np.random.default_rng(seed)
+    sents = [[f"w{i}" for i in rng.integers(0, 30, 20)] for _ in range(n)]
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=8, pairs_per_batch=128, window=3,
+                         num_iterations=2, steps_per_dispatch=2,
+                         heartbeat_every_steps=2, subsample_ratio=0.0,
+                         prefetch_chunks=0, seed=1, **cfg_kw)
+    return Trainer(cfg, vocab), encode_sentences(sents, vocab, 1000)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(port, path, timeout=5):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout)
+
+
+# -- unit: the server + prometheus rendering -------------------------------------------
+
+
+def test_server_routes_and_readonly():
+    snap = {"global_step": 7, "status": "running", "pairs_per_sec": 123.0,
+            "lr_scale": 0.5, "recoveries": 1,
+            "norms": {"syn0": {"max_norm": 2.5, "frac_over": 0.0}},
+            "phases": {"dispatch": {"count": 3, "total_s": 0.5,
+                                    "p99_s": 0.2}}}
+    srv = StatusServer(0, lambda: snap).start()  # ephemeral port (unit only;
+    try:                                         # config refuses 0 as "on")
+        port = srv.port
+        assert json.load(_get(port, "/status.json"))["global_step"] == 7
+        assert json.load(_get(port, "/"))["status"] == "running"
+        assert _get(port, "/healthz").read() == b"ok\n"
+        text = _get(port, "/metrics").read().decode()
+        assert "glint_global_step 7" in text
+        assert 'glint_norm_max_norm{matrix="syn0"} 2.5' in text
+        assert 'glint_phase_seconds_total{phase="dispatch"} 0.5' in text
+        assert "glint_running 1" in text
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/shutdown")
+        assert e.value.code == 404
+    finally:
+        srv.stop()
+    # stopped: the port no longer answers
+    with pytest.raises((ConnectionRefusedError, urllib.error.URLError)):
+        _get(port, "/healthz", timeout=1)
+
+
+def test_prometheus_text_skips_missing_and_none():
+    text = prometheus_text({"global_step": 1, "pairs_per_sec": None,
+                            "status": "idle"})
+    assert "glint_global_step 1" in text
+    assert "pairs_per_sec" not in text
+    assert "glint_running 0" in text
+
+
+# -- trainer integration ---------------------------------------------------------------
+
+
+def test_serves_during_fit_and_stops_after(tmp_path):
+    port = _free_port()
+    trainer, enc = _toy_trainer(
+        telemetry_path=str(tmp_path / "run.jsonl"), status_port=port)
+    seen = {}
+
+    def on_hb(rec):
+        if seen:
+            return
+        seen["snap"] = json.load(_get(port, "/status.json"))
+        seen["metrics"] = _get(port, "/metrics").read().decode()
+
+    trainer.fit(enc, on_heartbeat=on_hb)
+    snap = seen["snap"]
+    assert snap["status"] == "running"
+    assert snap["global_step"] >= 2
+    assert snap["run_id"]
+    assert snap["norms"]["syn0"]["max_norm"] > 0
+    assert "glint_pairs_per_sec" in seen["metrics"]
+    assert 'glint_phase_seconds_total{phase="dispatch"}' in seen["metrics"]
+    # fit-scoped: the endpoint is gone once the run ended
+    assert trainer._statusd is None
+    with pytest.raises((ConnectionRefusedError, urllib.error.URLError)):
+        _get(port, "/healthz", timeout=1)
+    # the snapshot is still callable offline (idle state)
+    assert trainer.status_snapshot()["status"] == "idle"
+
+
+def test_status_without_telemetry_arms_phases(tmp_path):
+    """status_port alone (no sink) must still attribute time — the endpoint
+    serves phases without requiring a run log on disk."""
+    port = _free_port()
+    trainer, enc = _toy_trainer(n=60, status_port=port)
+    trainer.fit(enc)
+    assert trainer._phases.enabled
+    assert trainer.status_snapshot()["phases"]["dispatch"]["count"] > 0
+
+
+def test_zero_cost_when_off():
+    """The acceptance contract: status_port=0 (default) creates NO thread
+    and binds NO socket."""
+    before = {t.name for t in threading.enumerate()}
+    trainer, enc = _toy_trainer(n=60)
+    trainer.fit(enc)
+    after = {t.name for t in threading.enumerate()}
+    assert trainer._statusd is None
+    assert not any("statusd" in name for name in after - before)
+
+
+def test_status_port_validation():
+    with pytest.raises(ValueError, match="status_port"):
+        Word2VecConfig(status_port=-1)
+    with pytest.raises(ValueError, match="status_port"):
+        Word2VecConfig(status_port=70000)
+    with pytest.raises(ValueError, match="blackbox_ring"):
+        Word2VecConfig(blackbox_ring=0)

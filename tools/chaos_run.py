@@ -28,7 +28,13 @@ Every fault is deterministic (train/faults.py) — no sleep/kill-timing races:
    and FINISH with finite params and ``recoveries_performed >= 1``; a
    repeatedly-reblowing run past ``max_recoveries`` must degrade to the
    halt contract (NormBlowupError).
-6. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
+6. **blackbox** — chaos-proven forensics (docs/observability.md): a
+   SIGTERM'd telemetry-on subprocess (``crash_at_step`` +
+   ``crash_signal=TERM`` — the preemption first-warning surface) and an
+   injected finite blowup under ``norm_watch="halt"`` must each leave a
+   schema-valid ``<telemetry_path>.blackbox.json`` flight-recorder dump
+   carrying ≥ 1 heartbeat and the terminal cause (signal / exception).
+7. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
    exponential-backoff wrapper in ``data/`` must absorb them.
 
 Usage::
@@ -89,6 +95,17 @@ def worker_crash(workdir: str, n_sentences: int) -> None:
     _fit(toy_sentences(n_sentences), toy_config(),
          checkpoint_path=os.path.join(workdir, "ck"),
          checkpoint_every_steps=2)
+    print("WORKER SURVIVED (fault did not fire)", flush=True)
+    sys.exit(3)
+
+
+def worker_blackbox(workdir: str, n_sentences: int) -> None:
+    """The SIGTERM'd telemetry-on leg of the blackbox phase — launched with
+    GLINT_FAULT_CRASH_AT_STEP + GLINT_FAULT_CRASH_SIGNAL=TERM in its env,
+    so the trainer's SIGTERM hook (obs/blackbox.py) must dump the flight
+    recorder before the process dies. Never returns normally."""
+    _fit(toy_sentences(n_sentences), toy_config(
+        telemetry_path=os.path.join(workdir, "run.jsonl")))
     print("WORKER SURVIVED (fault did not fire)", flush=True)
     sys.exit(3)
 
@@ -270,6 +287,85 @@ def phase_norm_recover() -> str:
     return "budget-exhaustion run finished instead of halting"
 
 
+def phase_blackbox(workdir: str, n_sentences: int) -> str:
+    """Chaos-proven forensics (ISSUE 9): an injected crash (SIGTERM'd
+    subprocess — the preemption first-warning surface) and an injected
+    finite blowup (NormBlowupError through the abort path) must each leave
+    a SCHEMA-VALID ``<telemetry_path>.blackbox.json`` carrying the ring
+    contents (>= 1 heartbeat) and the terminal cause record."""
+    import json
+    from glint_word2vec_tpu.obs.schema import validate_blackbox_file
+    from glint_word2vec_tpu.train import faults
+    from glint_word2vec_tpu.train.faults import NormBlowupError
+
+    # 1. injected crash: SIGTERM at a scripted step, in a real subprocess —
+    #    the dump must be written by the signal hook before the process dies
+    crash_dir = os.path.join(workdir, "crash")
+    os.makedirs(crash_dir, exist_ok=True)
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               GLINT_FAULT_CRASH_AT_STEP="8",
+               GLINT_FAULT_CRASH_SIGNAL="TERM")
+    rc = subprocess.call(
+        [sys.executable, os.path.abspath(__file__), "--worker", "blackbox",
+         "--workdir", crash_dir, "--sentences", str(n_sentences)],
+        env=env)
+    if rc not in (-15, 143):
+        return f"worker exited {rc}, expected SIGTERM (-15/143)"
+    dump = os.path.join(crash_dir, "run.jsonl.blackbox.json")
+    if not os.path.exists(dump):
+        return "SIGTERM'd run left no blackbox dump"
+    v = validate_blackbox_file(dump)
+    if not v["ok"]:
+        return f"crash dump not schema-valid: {v['errors'][:3]}"
+    with open(dump) as f:
+        doc = json.load(f)
+    if doc["cause"] != {"kind": "signal", "signal": "SIGTERM", "signum": 15}:
+        return f"crash dump cause wrong: {doc['cause']}"
+    if len(doc["heartbeats"]) < 1:
+        return "crash dump carries no heartbeats"
+    if not doc["dispatches"]:
+        return "crash dump carries no dispatch records"
+
+    # 2. injected finite blowup: NormBlowupError rides the abort path and
+    #    must dump with the exception as the terminal cause (and the
+    #    watchdog record in the event ring — the record-before-raise
+    #    contract made durable)
+    blow_dir = os.path.join(workdir, "blowup")
+    os.makedirs(blow_dir, exist_ok=True)
+    run_log = os.path.join(blow_dir, "run.jsonl")
+    faults.configure(scale_params_at_step=8)
+    try:
+        _fit(toy_sentences(n_sentences, seed=2),
+             toy_config("halt", norm_watch="halt", telemetry_path=run_log))
+        return "norm_watch='halt' finished instead of raising"
+    except NormBlowupError:
+        pass
+    except Exception as e:  # noqa: BLE001
+        return f"blowup raised the wrong error: {e}"
+    finally:
+        faults.reset()
+    dump = run_log + ".blackbox.json"
+    if not os.path.exists(dump):
+        return "blowup run left no blackbox dump"
+    v = validate_blackbox_file(dump)
+    if not v["ok"]:
+        return f"blowup dump not schema-valid: {v['errors'][:3]}"
+    with open(dump) as f:
+        doc = json.load(f)
+    cause = doc["cause"]
+    if cause.get("kind") != "exception" or cause.get("type") != "NormBlowupError":
+        return f"blowup dump cause wrong: {cause}"
+    if len(doc["heartbeats"]) < 1:
+        return "blowup dump carries no heartbeats"
+    kinds = [e["kind"] for e in doc["events"]]
+    if "watchdog" not in kinds:
+        return f"blowup dump events missing the watchdog record ({kinds})"
+    if "run_end" not in kinds:
+        return f"blowup dump events missing the terminal run_end ({kinds})"
+    return ""
+
+
 def phase_flaky_ingest(workdir: str) -> str:
     from glint_word2vec_tpu.data.corpus import encode_corpus
     from glint_word2vec_tpu.data.vocab import build_vocab
@@ -295,7 +391,7 @@ def main() -> int:
                     help="small corpus / fast phases (tier-1 smoke)")
     ap.add_argument("--workdir", default="",
                     help="working directory (default: a fresh temp dir)")
-    ap.add_argument("--worker", choices=["crash"],
+    ap.add_argument("--worker", choices=["crash", "blackbox"],
                     help="internal: run a fault-target worker leg")
     ap.add_argument("--sentences", type=int, default=0)
     args = ap.parse_args()
@@ -303,6 +399,9 @@ def main() -> int:
     n_sentences = args.sentences or (300 if args.smoke else 1500)
     if args.worker == "crash":
         worker_crash(args.workdir, n_sentences)
+        return 3  # unreachable
+    if args.worker == "blackbox":
+        worker_blackbox(args.workdir, n_sentences)
         return 3  # unreachable
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="glint_chaos_")
@@ -316,6 +415,8 @@ def main() -> int:
         ("nan-halt", lambda: phase_nan("halt")),
         ("norm-blowup", phase_norm_blowup),
         ("norm-recover", phase_norm_recover),
+        ("blackbox",
+         lambda: phase_blackbox(os.path.join(workdir, "p5"), n_sentences)),
         ("flaky-ingest",
          lambda: phase_flaky_ingest(os.path.join(workdir, "p4"))),
     ]

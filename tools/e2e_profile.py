@@ -6,6 +6,16 @@ Stages measured on the bench corpus (4M words, 50k vocab, Zipf):
     pairgen-only   — raw epoch_batches drain (no K-stacking/packing/alpha)
     e2e fit        — the real thing (3 trials, median), with host-wait/dispatch split
 
+Since round 13 the e2e leg RIDES THE TELEMETRY LAYER (docs/observability.md)
+instead of private timers: each trial runs with a sink + spans + the
+per-phase log2 histograms armed, and the report is the same per-phase
+attribution (producer-wait / stage / dispatch / device-block, p50/p99/total)
+every telemetry-on production run gets — one owner of e2e profiling, so this
+tool can never drift from what the run log says. The run artifacts
+(`run.jsonl`, `.trace.json`) are left under --out (default: a temp dir) for
+Perfetto/run_report.py; the CLI contract (flags, human output on stderr) is
+unchanged.
+
 Run on TPU: python tools/e2e_profile.py [--batch 65536] [--pool 512] [--k 32]
 """
 
@@ -14,6 +24,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -32,12 +43,19 @@ def main() -> None:
     ap.add_argument("--device-pairgen", action="store_true")
     ap.add_argument("--skip-host-stages", action="store_true")
     ap.add_argument("--skip-fit", action="store_true")
+    ap.add_argument("--out", default="",
+                    help="where the telemetry artifacts (run.jsonl + "
+                         ".trace.json) land; default: a fresh temp dir")
     args = ap.parse_args()
 
     from glint_word2vec_tpu.config import Word2VecConfig
     from glint_word2vec_tpu.data.pipeline import encode_sentences, epoch_batches
     from glint_word2vec_tpu.data.vocab import build_vocab
     from glint_word2vec_tpu.train.trainer import Trainer
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="glint_e2e_profile_")
+    os.makedirs(out_dir, exist_ok=True)
+    run_log = os.path.join(out_dir, "run.jsonl")
 
     rng = np.random.default_rng(0)
     n_words, sent_len, vocab_sz = 4_000_000, 40, 50_000
@@ -51,13 +69,14 @@ def main() -> None:
         num_iterations=1, window=5, negatives=5, negative_pool=args.pool,
         steps_per_dispatch=args.k, seed=1, subsample_ratio=1e-4,
         prefetch_chunks=args.prefetch, logits_dtype=args.logits,
-        param_dtype=args.param_dtype, device_pairgen=args.device_pairgen)
+        param_dtype=args.param_dtype, device_pairgen=args.device_pairgen,
+        telemetry_path=run_log)
     encoded = encode_sentences(sentences, vocab, cfg.max_sentence_length)
 
     trainer = Trainer(cfg, vocab)
     from glint_word2vec_tpu.data.native import native_available
     print(f"native pairgen: {native_available()}  device_pairgen: "
-          f"{cfg.device_pairgen}", file=sys.stderr)
+          f"{cfg.device_pairgen}  telemetry -> {run_log}", file=sys.stderr)
     if cfg.device_pairgen:
         print(f"tokens_per_step: {trainer._tokens_per_step}", file=sys.stderr)
 
@@ -92,7 +111,7 @@ def main() -> None:
     if args.skip_fit:
         return
 
-    # --- full e2e ------------------------------------------------------------
+    # --- full e2e, attributed through the telemetry layer --------------------
     import jax.numpy as jnp
     trainer.fit(encoded[:400])  # warm jit
     rates = []
@@ -110,6 +129,20 @@ def main() -> None:
         if not np.isfinite(float(jnp.sum(trainer.params.syn0[:1024]))):
             raise RuntimeError("diverged")
     print(f"e2e median: {float(np.median(rates)):,.0f} pairs/s", file=sys.stderr)
+    # per-phase attribution of the LAST trial (obs/phases.py — the same
+    # rollup the run log's run_end record carries)
+    phases = trainer.last_run_stats.get("phases", {})
+    for name in ("producer_wait", "stage", "dispatch", "device_block"):
+        ph = phases.get(name)
+        if not ph:
+            continue
+        print(f"  phase {name:14s} count {ph['count']:>6}  "
+              f"total {ph['total_s']:8.2f}s  p50 {ph['p50_s']:.2e}s  "
+              f"p99 {ph['p99_s']:.2e}s  max {ph['max_s']:.3f}s",
+              file=sys.stderr)
+    print(f"artifacts: {run_log} (+ .trace.json) — summarize with "
+          f"tools/run_report.py, tail with tools/telemetry_tail.py",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -118,6 +118,11 @@ KNOBS = {k.name: k for k in [
     _K("recover_lr_backoff", (0.5, 1.0), invalid=0.0, dispatch_inert=True),
     _K("max_recoveries", (0, 4), invalid=-1, dispatch_inert=True),
     _K("profile_steps", (0, 10), invalid=-1, dispatch_inert=True),
+    _K("status_port", (0,), invalid=-1, dispatch_inert=True,
+       pinned="side-effectful at fit start (binds a localhost socket + "
+              "serving thread); the statusd contract incl. zero-cost-when-"
+              "off is tested in tests/test_statusd.py"),
+    _K("blackbox_ring", (1, 256), invalid=0, dispatch_inert=True),
 ]}
 
 

@@ -55,6 +55,11 @@ class R1ThreadPools:
         ("glint_word2vec_tpu/data/pipeline.py", "ordered_pool_map"),
         ("glint_word2vec_tpu/train/trainer.py", "_threaded_iter.__init__"),
         ("glint_word2vec_tpu/train/trainer.py", "_one_ahead_iter.__init__"),
+        # the status endpoint's serving thread (obs/statusd.py): READ-only —
+        # it renders snapshots of trainer state and never produces or orders
+        # training data, so the worker-count determinism contract R1 guards
+        # is untouched (docs/observability.md)
+        ("glint_word2vec_tpu/obs/statusd.py", "StatusServer.start"),
     }
 
     def applies(self, path: str) -> bool:
@@ -425,6 +430,7 @@ class R7JsonStdout:
         "bench.py", "__graft_entry__.py", "tools/hostbench.py",
         "tools/collectives.py", "tools/shard_ab.py", "tools/stepaudit.py",
         "tools/telemetry_run.py", "tools/graftcheck/__main__.py",
+        "tools/run_report.py", "tools/perfgate.py",
     }
 
     def applies(self, path: str) -> bool:

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Throughput regression gate over the committed BENCH_r*.json trajectory.
+
+The BENCH trajectory (r01 → r05: 3.44M → 11.18M step pairs/s) is the repo's
+perf ground truth, but until now nothing CHECKED a fresh bench line against
+it — a regression would land silently and surface rungs later as "huh, r06
+is slower". This gate compares a fresh ``bench.py`` JSON line against the
+committed trajectory with EXPLICIT per-metric tolerance bands and fails
+loudly when a gated metric falls below band.
+
+Gate rule, per metric: ``new >= (1 - band) * latest_rung`` — the latest
+committed rung is the CURRENT claim a fresh line must hold. The historical
+best is reported beside it as an advisory ``drift_from_best`` (the
+committed trajectory itself is not monotonic: r03's f32 step row beats
+r05's by ~12% — a real drift the rungs absorbed while the headline moved
+to bf16 — so gating on the all-time best would fail the genuine current
+line; the advisory keeps that drift visible instead of burying it).
+
+Tolerance-band provenance (docs/observability.md has the full table): the
+bands come from the measured trial spread of the bench harness itself —
+bench.py step rows report min/median/max over 3 interleaved trials
+(BENCH r04+), where the committed rungs show up to ~6% median-to-min spread
+on the step metrics and wider spread on the e2e row (host-pipeline noise,
+PERF.md §3/§5). Bands are set ≥ 2x the observed spread so the gate fires on
+regressions, not on weather; tighten them on a quieter host, in the JSON,
+with provenance.
+
+Modes::
+
+    python tools/perfgate.py --bench fresh_bench.json   # gate a real run
+    python tools/perfgate.py --smoke                    # self-test (CI)
+
+``--smoke`` is machine-independent (CI containers cannot reproduce
+capable-host numbers): it proves the GATE works — the genuine latest
+committed rung must pass against the trajectory, and a seeded regression
+(every gated metric scaled by --seed-factor, default 0.7 — below every
+band) must fire. A
+real ``--bench`` run belongs on the host class the baselines came from.
+
+Prints exactly ONE JSON line on stdout (graftlint R7); chatter to stderr.
+Exit 0 iff the gate holds (or, under --smoke, iff genuine-passes AND
+seeded-fires).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# gated metric -> tolerance band (fraction below trajectory-best tolerated).
+# Provenance: >= 2x the observed cross-trial/cross-rung spread (module doc).
+GATED: Dict[str, float] = {
+    # headline single-chip step throughput; step_trials_ms spread <= ~6%
+    "value": 0.12,
+    # f32 step twin, same harness
+    "step_f32_pairs_per_sec": 0.12,
+    # e2e trainer row folds the host pipeline in — noisier (PERF.md §5)
+    "e2e_pairs_per_sec": 0.25,
+    # large-vocab step row (scatter-bound regime)
+    "v1m_step_pairs_per_sec": 0.15,
+    # CBOW step row
+    "cbow_examples_per_sec": 0.20,
+}
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _load_parsed(path: str) -> dict:
+    """A bench JSON: either the raw one-line bench.py output (the metric
+    dict itself) or a driver capture wrapping it under 'parsed'."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    return doc.get("parsed", doc)
+
+
+def load_trajectory(pattern: str) -> List[dict]:
+    paths = sorted(glob.glob(pattern))
+    rungs = []
+    for p in paths:
+        try:
+            parsed = _load_parsed(p)
+        except (OSError, json.JSONDecodeError) as e:
+            log(f"skipping unreadable baseline {p}: {e}")
+            continue
+        rungs.append({"path": os.path.basename(p), "parsed": parsed})
+    return rungs
+
+
+def gate(new: dict, rungs: List[dict],
+         bands: Optional[Dict[str, float]] = None) -> dict:
+    """Compare one fresh parsed bench dict against the trajectory. Metrics
+    absent from the new line are reported (a vanished metric is itself
+    suspicious) but only gated when at least one rung carries them."""
+    bands = bands or GATED
+    metrics = {}
+    ok = True
+    for name, band in bands.items():
+        history = [(r["path"], float(r["parsed"][name]))
+                   for r in rungs if name in r["parsed"]]
+        if not history:
+            continue
+        ref_path, ref = history[-1]           # the latest rung: the claim
+        best_path, best = max(history, key=lambda kv: kv[1])
+        floor = (1.0 - band) * ref
+        entry = {"ref": ref, "ref_rung": ref_path, "band": band,
+                 "floor": round(floor, 1),
+                 # advisory: how far the current claim itself sits below the
+                 # all-time best (non-monotonic trajectory drift)
+                 "best": best, "best_rung": best_path,
+                 "drift_from_best": round(1.0 - ref / best, 4)}
+        if name not in new:
+            metrics[name] = {**entry, "new": None, "ok": False,
+                             "why": "metric missing from the fresh line"}
+            ok = False
+            continue
+        val = float(new[name])
+        passed = val >= floor
+        metrics[name] = {**entry, "new": val,
+                         "ratio_to_ref": round(val / ref, 4), "ok": passed}
+        ok = ok and passed
+    return {"ok": ok, "metrics": metrics,
+            "rungs": [r["path"] for r in rungs]}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--bench", default="",
+                    help="fresh bench.py JSON (raw line or driver capture) "
+                         "to gate against the trajectory")
+    ap.add_argument("--baselines", default=os.path.join(_REPO,
+                                                        "BENCH_r*.json"),
+                    help="glob of committed trajectory rungs")
+    ap.add_argument("--smoke", action="store_true",
+                    help="machine-independent self-test: the genuine latest "
+                         "rung must pass, a seeded regression must fire")
+    ap.add_argument("--seed-factor", type=float, default=0.7,
+                    help="--smoke: scale factor of the seeded regression "
+                         "(must sit below every band to prove firing)")
+    args = ap.parse_args()
+
+    result, rc = _run(args)
+    print(json.dumps(result))  # the ONE stdout line (graftlint R7)
+    return rc
+
+
+def _run(args) -> tuple:
+    """All modes funnel through here so main() keeps exactly one
+    ``print(json.dumps(...))`` (the R7 stdout contract)."""
+    rungs = load_trajectory(args.baselines)
+    if len(rungs) < 2:
+        return {"ok": False,
+                "error": f"need >= 2 baseline rungs at {args.baselines}, "
+                         f"found {len(rungs)}"}, 2
+
+    if args.smoke:
+        genuine = rungs[-1]["parsed"]
+        g = gate(genuine, rungs)
+        seeded = {k: float(genuine[k]) * args.seed_factor
+                  for k in GATED if k in genuine}
+        s = gate(seeded, rungs)
+        fired_on = sorted(k for k, m in s["metrics"].items()
+                          if not m["ok"])
+        result = {
+            # the gate is proven iff the real current line is inside band
+            # AND the seeded regression trips it
+            "ok": bool(g["ok"] and not s["ok"]),
+            "mode": "smoke",
+            "genuine": {"rung": rungs[-1]["path"], "ok": g["ok"],
+                        "metrics": g["metrics"]},
+            "seeded": {"factor": args.seed_factor, "ok": s["ok"],
+                       "fired_on": fired_on},
+            "rungs": g["rungs"],
+        }
+        log(f"perfgate --smoke: genuine {rungs[-1]['path']} "
+            f"{'PASS' if g['ok'] else 'FAIL'}; seeded x{args.seed_factor} "
+            f"{'fired on ' + ','.join(fired_on) if fired_on else 'DID NOT FIRE'}")
+        return result, 0 if result["ok"] else 1
+
+    if not args.bench:
+        return {"ok": False,
+                "error": "pass --bench FRESH.json or --smoke"}, 2
+    try:
+        new = _load_parsed(args.bench)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"ok": False,
+                "error": f"unreadable --bench {args.bench}: {e}"}, 2
+    result = gate(new, rungs)
+    result["mode"] = "gate"
+    result["bench"] = args.bench
+    for name, m in result["metrics"].items():
+        log(f"perfgate {name}: new {m['new']} vs ref {m['ref']} "
+            f"({m['ref_rung']}), floor {m['floor']} -> "
+            f"{'ok' if m['ok'] else 'REGRESSION'}")
+    return result, 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Fold a telemetry JSONL run log into ONE summary JSON line.
+
+The machine half of post-run inspection (docs/observability.md): where
+``telemetry_tail.py`` renders for a human, this tool reduces a whole run
+log — plus optionally the matching ``.blackbox.json`` dump and EVAL_RUNS
+rows — into one machine-readable line a driver/CI can archive, diff, and
+gate on. Prints exactly ONE JSON line on stdout (graftlint R7); all chatter
+goes to stderr.
+
+Summary fields: the run bracket (run_id/status/steps/pairs), throughput
+distribution over the heartbeat windows (median/p10/p90/last pairs/s), the
+host-wait/dispatch totals AND the per-phase time-attribution rollup (from
+run_end, falling back to summing heartbeat windows for a truncated log —
+exactly the crash case the blackbox exists for), recovery/watchdog state,
+and the norm-channel trajectory (first/last/max of syn0+syn1 max_norm).
+
+Usage::
+
+    python tools/run_report.py run.jsonl [run.jsonl.1 ...]
+        [--blackbox run.jsonl.blackbox.json]
+        [--eval-runs EVAL_RUNS.jsonl] [--eval-last N]
+
+Exit code 0 iff the log parsed and (when the run ended) ended "ok";
+a truncated log (no run_end) reports ``"status": "truncated"`` and exits 1
+— a remote driver can alarm on exactly that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def _merge_phase_windows(windows: List[dict]) -> dict:
+    """Sum per-heartbeat phase rollups into one run-level rollup (the
+    fallback when run_end — which carries the exact cumulative — is
+    missing). Bucketed quantiles re-derive from the merged sparse hists."""
+    from glint_word2vec_tpu.obs.phases import (
+        HIST_BUCKETS, PhaseAccumulator)
+    out: dict = {}
+    for w in windows:
+        for name, ph in (w or {}).items():
+            acc = out.setdefault(name, {"count": 0, "total_s": 0.0,
+                                        "hist": [0] * HIST_BUCKETS})
+            acc["count"] += int(ph.get("count", 0))
+            acc["total_s"] += float(ph.get("total_s", 0.0))
+            for idx, c in (ph.get("hist") or {}).items():
+                i = int(idx)
+                if 0 <= i < HIST_BUCKETS:
+                    acc["hist"][i] += int(c)
+    return {name: PhaseAccumulator._summarize(
+                acc["count"], acc["total_s"], acc["hist"])
+            for name, acc in out.items()}
+
+
+def summarize(paths: List[str], blackbox: str = "",
+              eval_runs: str = "", eval_last: int = 1) -> dict:
+    from glint_word2vec_tpu.obs.schema import (
+        validate_blackbox_file, validate_file)
+    kinds: dict = {}
+    heartbeats: List[dict] = []
+    run_start: Optional[dict] = None
+    run_end: Optional[dict] = None
+    watchdog = 0
+    recoveries: List[dict] = []
+    schema_ok = True
+    schema_errors: List[str] = []
+    for path in paths:
+        v = validate_file(path)
+        schema_ok = schema_ok and v["ok"]
+        schema_errors.extend(v["errors"][:5])
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # counted via the validator above
+                kind = r.get("kind", "?")
+                kinds[kind] = kinds.get(kind, 0) + 1
+                if kind == "heartbeat":
+                    heartbeats.append(r)
+                elif kind == "run_start":
+                    run_start = r
+                elif kind == "run_end":
+                    run_end = r
+                elif kind == "watchdog":
+                    watchdog += 1
+                elif kind == "recovery":
+                    recoveries.append(r)
+
+    pps = sorted(float(h["pairs_per_sec"]) for h in heartbeats
+                 if h.get("pairs_per_sec"))
+    status = run_end["status"] if run_end else "truncated"
+    phases = (run_end or {}).get("phases")
+    if not phases:
+        phases = _merge_phase_windows(
+            [h.get("phases") for h in heartbeats if h.get("phases")])
+
+    def _norm_track(matrix: str) -> dict:
+        vals = [(h["norms"][matrix]["max_norm"]) for h in heartbeats
+                if (h.get("norms") or {}).get(matrix, {}).get("max_norm")
+                is not None]
+        if not vals:
+            return {}
+        return {"first": vals[0], "last": vals[-1], "max": max(vals)}
+
+    report = {
+        "ok": bool(schema_ok and status == "ok"),
+        "paths": paths,
+        "schema_valid": schema_ok,
+        "schema_errors": schema_errors[:5],
+        "run_id": (run_end or run_start or {}).get("run_id"),
+        "status": status,
+        "kinds": kinds,
+        "steps": (run_end or {}).get("steps",
+                                     heartbeats[-1]["step"] if heartbeats
+                                     else 0),
+        "pairs_trained": (run_end or {}).get("pairs_trained"),
+        "wall_s": (round(run_end["t"] - run_start["t"], 3)
+                   if run_end and run_start else None),
+        "heartbeats": len(heartbeats),
+        "pairs_per_sec": {
+            "median": round(_quantile(pps, 0.5), 1),
+            "p10": round(_quantile(pps, 0.10), 1),
+            "p90": round(_quantile(pps, 0.90), 1),
+            "last": round(pps[-1], 1) if pps else 0.0,
+        } if pps else None,
+        "host_wait_s_total": (run_end or {}).get("host_wait_s_total"),
+        "dispatch_s_total": (run_end or {}).get("dispatch_s_total"),
+        "phases": phases,
+        "watchdog_fires": watchdog if not run_end
+        else run_end.get("watchdog_fires", watchdog),
+        "recoveries": len(recoveries) if not run_end
+        else run_end.get("recoveries", len(recoveries)),
+        "lr_scale_final": (run_end or {}).get(
+            "lr_scale", heartbeats[-1].get("lr_scale") if heartbeats
+            else None),
+        "norms": {m: t for m in ("syn0", "syn1")
+                  if (t := _norm_track(m))} or None,
+    }
+    if blackbox:
+        bb = validate_blackbox_file(blackbox)
+        report["blackbox"] = {"path": blackbox, "valid": bb["ok"],
+                              "kinds": bb["kinds"],
+                              "errors": bb["errors"][:3]}
+        if bb["ok"]:
+            with open(blackbox, "r", encoding="utf-8") as f:
+                report["blackbox"]["cause"] = json.load(f)["cause"]
+    if eval_runs:
+        rows = []
+        try:
+            with open(eval_runs, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+        except (OSError, json.JSONDecodeError) as e:
+            report["eval"] = {"error": str(e)}
+        else:
+            keep = ("purity", "analogy_acc1", "emb_abs_max", "row_norm_max",
+                    "row_norm_p99", "rows_norm_over_100", "vocab_size",
+                    "words", "gen_version", "stab_ab_arm", "diverged")
+            report["eval"] = [
+                {k: r[k] for k in keep if k in r}
+                for r in rows[-max(eval_last, 1):]]
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("paths", nargs="+",
+                    help="sink JSONL file(s), oldest rotated segment first")
+    ap.add_argument("--blackbox", default="",
+                    help="also validate + fold in a .blackbox.json dump")
+    ap.add_argument("--eval-runs", default="",
+                    help="append the last EVAL_RUNS rows (quality metrics)")
+    ap.add_argument("--eval-last", type=int, default=1,
+                    help="how many trailing EVAL_RUNS rows to include")
+    args = ap.parse_args()
+    report = summarize(args.paths, blackbox=args.blackbox,
+                       eval_runs=args.eval_runs, eval_last=args.eval_last)
+    print(json.dumps(report, allow_nan=False))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,12 +92,17 @@ def scripted_fit(out_dir: str, n_sentences: int) -> dict:
     except (OSError, json.JSONDecodeError, KeyError) as e:
         summary["errors"] = summary.get("errors", []) + [f"trace: {e}"]
     missing = [s for s in REQUIRED_SPANS if s not in spans]
-    ok = bool(summary["ok"] and trace_ok and not missing
+    # a CLEAN run must leave no flight-recorder dump — the blackbox is a
+    # death artifact (obs/blackbox.py); chaos_run's `blackbox` phase proves
+    # the dying-run half
+    blackbox_absent = not os.path.exists(run_log + ".blackbox.json")
+    ok = bool(summary["ok"] and trace_ok and not missing and blackbox_absent
               and summary["kinds"].get("run_start") == 1
               and summary["kinds"].get("run_end") == 1
               and summary["kinds"].get("heartbeat", 0) >= 1)
     return {
         "ok": ok,
+        "blackbox_absent": blackbox_absent,
         "run_log": run_log,
         "trace": trace_path,
         "records": summary["records"],
@@ -111,8 +116,17 @@ def scripted_fit(out_dir: str, n_sentences: int) -> dict:
     }
 
 
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def measure_overhead(n_sentences: int, trials: int = 4,
-                     workdir: str = "") -> dict:
+                     workdir: str = "", status: bool = False) -> dict:
     """Interleaved telemetry-off/on A/B at heartbeat cadence (the PERF.md §3
     interleaving methodology), with two noise defenses this container made
     necessary: (1) ALTERNATING arm order per trial — a fixed off-then-on
@@ -122,7 +136,13 @@ def measure_overhead(n_sentences: int, trials: int = 4,
     cadence (6x more frequent than the production default of 100) — probing
     a microsecond-step toy fit every 2 steps measures the probe's fixed
     cost, not the heartbeat-cadence overhead the acceptance bar is about.
-    Importable — bench.py --smoke prints this measurement as its JSON line."""
+    Importable — bench.py --smoke prints this measurement as its JSON line.
+
+    ``status=True``: the on arm ADDITIONALLY serves the live status endpoint
+    (config.status_port, obs/statusd.py) and each on-trial scrapes
+    /status.json + /metrics once mid-fit from the heartbeat callback — so
+    the measured arm is a REALLY-serving endpoint, not an idle socket. Same
+    < 2% acceptance bar (docs/observability.md)."""
     workdir = workdir or tempfile.mkdtemp(prefix="glint_obs_bench_")
     # floor the corpus so every fit spans >= ~10 heartbeat windows — the
     # steady-state scoring below needs windows to drop and windows to keep
@@ -150,15 +170,36 @@ def measure_overhead(n_sentences: int, trials: int = 4,
     # of compile into a ~5 s fit and swamp a 2% bar with compile-time noise.
     warmup = 2
     samples = {"off": [], "on": []}
+    scrapes = 0
     for trial in range(trials):
         arms = ("off", "on") if trial % 2 == 0 else ("on", "off")
         for arm in arms:
             kw = {}
+            on_heartbeat = None
             if arm == "on":
                 kw = dict(telemetry_path=os.path.join(
                     workdir, f"run_{trial}.jsonl"), norm_watch="warn")
+                if status:
+                    port = _free_port()
+                    kw["status_port"] = port
+                    scraped = []
+
+                    def on_heartbeat(rec, _port=port, _s=scraped):
+                        if _s:
+                            return
+                        import urllib.request
+                        snap = json.load(urllib.request.urlopen(
+                            f"http://127.0.0.1:{_port}/status.json",
+                            timeout=5))
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{_port}/metrics",
+                            timeout=5).read()
+                        assert snap["status"] == "running", snap
+                        _s.append(True)
             trainer, enc = build(**kw)
-            trainer.fit(enc)
+            trainer.fit(enc, on_heartbeat=on_heartbeat)
+            if arm == "on" and status:
+                scrapes += len(scraped)
             window_pps = [hb.pairs_per_sec
                           for hb in trainer.heartbeats][warmup:]
             samples[arm].extend(window_pps)
@@ -169,7 +210,13 @@ def measure_overhead(n_sentences: int, trials: int = 4,
     on = float(np.median(samples["on"]))
     spread = float(np.percentile(samples["off"], 75)
                    / max(np.percentile(samples["off"], 25), 1e-9) - 1.0)
+    if status:
+        assert scrapes == trials, (
+            f"status arm scraped {scrapes}/{trials} fits — the endpoint "
+            f"was not live during every on-trial")
     return {
+        **({"status_arm": True, "status_scrapes": scrapes}
+           if status else {}),
         "telemetry_off_pairs_per_sec": round(off, 1),
         "telemetry_on_pairs_per_sec": round(on, 1),
         # signed: a negative value means the on-arm measured FASTER, i.e. the
@@ -193,6 +240,10 @@ def main() -> int:
     ap.add_argument("--overhead", action="store_true",
                     help="also run the interleaved telemetry-off/on "
                          "throughput A/B")
+    ap.add_argument("--status-overhead", action="store_true",
+                    help="overhead A/B with the live status endpoint "
+                         "SERVING (and scraped mid-fit) on the on arm — "
+                         "the obs/statusd.py acceptance measurement")
     args = ap.parse_args()
 
     out_dir = args.out or tempfile.mkdtemp(prefix="glint_telemetry_")
@@ -204,6 +255,9 @@ def main() -> int:
     if args.overhead:
         result["overhead"] = measure_overhead(
             n, workdir=os.path.join(out_dir, "bench"))
+    if args.status_overhead:
+        result["status_overhead"] = measure_overhead(
+            n, workdir=os.path.join(out_dir, "bench_status"), status=True)
     print(json.dumps(result))
     return 0 if result["ok"] else 1
 
