@@ -520,6 +520,37 @@ class Word2VecConfig:
                                     # telemetry_path is set (the dump path
                                     # derives from it)
 
+    # --- serving tier (docs/serving.md; serve/ — read by the SERVING
+    # process, never by the trainer: dispatch-inert by construction. The
+    # knobs travel with the checkpoint like every other field, so a
+    # deployment's serving geometry is pinned beside the model it serves;
+    # EmbeddingService constructor arguments override per process) ---
+    serve_max_batch: int = 64       # micro-batcher coalescing cap: concurrent
+                                    # queries batch up to this many per device
+                                    # dispatch (the 13-16 ms batched path vs
+                                    # 230-375 ms per-query, PERF.md §6)
+    serve_max_delay_ms: float = 2.0  # batching deadline: a batch dispatches at
+                                    # most this long after its FIRST request
+                                    # arrived (bounds added latency; 0 =
+                                    # dispatch immediately, batch only what is
+                                    # already queued)
+    serve_queue_depth: int = 256    # bounded admission queue; a full queue
+                                    # refuses new requests FAST
+                                    # (ServerOverloaded, the 429 analog) —
+                                    # never unbounded buffering into latency
+                                    # collapse
+    serve_ann_centroids: int = 0    # IVF coarse cells. 0 = AUTO ~4·sqrt(V)
+                                    # (serve/ann.py auto_centroids: clamped so
+                                    # cells average >= 8 rows, ceiling 4096)
+    serve_ann_nprobe: int = 0       # cells probed per query. 0 = AUTO
+                                    # ~centroids/12 (~8% of the vocabulary
+                                    # scanned — the measured recall >= 0.95
+                                    # operating point on clustered embedding
+                                    # geometry, tools/servebench.py)
+    serve_reload_poll_s: float = 0.5  # hot-reload watcher poll cadence over
+                                    # the checkpoint publish signal
+                                    # (metadata.json identity; serve/reload.py)
+
     def __post_init__(self) -> None:
         if self.embedding_partition not in ("rows", "cols"):
             raise ValueError(
@@ -889,6 +920,30 @@ class Word2VecConfig:
         if self.blackbox_ring <= 0:
             raise ValueError(
                 f"blackbox_ring must be positive but got {self.blackbox_ring}")
+        if self.serve_max_batch <= 0:
+            raise ValueError(
+                f"serve_max_batch must be positive "
+                f"but got {self.serve_max_batch}")
+        if self.serve_max_delay_ms < 0:
+            raise ValueError(
+                f"serve_max_delay_ms must be nonnegative (0 = dispatch "
+                f"immediately) but got {self.serve_max_delay_ms}")
+        if self.serve_queue_depth <= 0:
+            raise ValueError(
+                f"serve_queue_depth must be positive "
+                f"but got {self.serve_queue_depth}")
+        if self.serve_ann_centroids < 0:
+            raise ValueError(
+                f"serve_ann_centroids must be nonnegative (0 = auto) "
+                f"but got {self.serve_ann_centroids}")
+        if self.serve_ann_nprobe < 0:
+            raise ValueError(
+                f"serve_ann_nprobe must be nonnegative (0 = auto) "
+                f"but got {self.serve_ann_nprobe}")
+        if self.serve_reload_poll_s <= 0:
+            raise ValueError(
+                f"serve_reload_poll_s must be positive "
+                f"but got {self.serve_reload_poll_s}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
         if (getattr(self, "_auto_pool", False)

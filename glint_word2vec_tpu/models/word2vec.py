@@ -84,6 +84,7 @@ class Word2VecModel:
         self._full0 = syn0
         self._full1 = syn1
         self._norms: Optional[jax.Array] = None
+        self._ann = None
         self._stopped = False
 
     @property
@@ -216,6 +217,22 @@ class Word2VecModel:
         v = jnp.asarray(vector, jnp.float32)
         return np.asarray(self.syn0 @ v)
 
+    # -- ANN index attach (serving tier, serve/ann.py) ---------------------------------
+
+    def attach_ann(self, index) -> None:
+        """Attach a built :class:`~glint_word2vec_tpu.serve.ann.IvfIndex`
+        so :meth:`find_synonyms_batch` can serve the approximate arm
+        (``ann=True``). The exact path stays the ground-truth oracle; the
+        index is a serving-time accessory, never persisted with the model
+        (it rebuilds from the matrix at load/publish time)."""
+        self._check_alive()
+        self._ann = index
+
+    @property
+    def ann(self):
+        """The attached ANN index, or None."""
+        return self._ann
+
     # -- synonym / analogy search (C8 mllib:554-630, C12 ml:375-420) -------------------
 
     def find_synonyms(
@@ -232,14 +249,30 @@ class Word2VecModel:
         queries: Sequence[Union[str, np.ndarray]],
         num: int,
         chunk: int = 128,
+        ann: bool = False,
+        nprobe: Optional[int] = None,
     ) -> List[List[Tuple[str, float]]]:
         """Batched :meth:`find_synonyms`: one device dispatch per ``chunk``
         queries instead of one per query. Through a thin host→device link the
         per-query round trip dominates (PERF.md §6: ~300 ms/query at V=1M rows);
         batching amortizes it — the [chunk, V] cosine matrix rides one matmul.
         Word queries exclude themselves (mllib:621-629); vector queries do not.
-        ``chunk`` bounds device memory at chunk·V·4 bytes of scores."""
+        ``chunk`` bounds device memory at chunk·V·4 bytes of scores.
+
+        ``ann=True`` routes the batch through the attached IVF index
+        (:meth:`attach_ann`) instead of the exact full-vocab scan — the
+        serving tier's fast arm (docs/serving.md): approximate top-k over
+        the ``nprobe`` nearest coarse cells, same result shape and the same
+        self-exclusion semantics; scores remain true cosines (candidates
+        are ranked exactly, only the candidate SET is approximate)."""
         self._check_alive()
+        if ann:
+            if self._ann is None:
+                raise RuntimeError(
+                    "ann=True but no index attached — build one with "
+                    "serve.ann.build_ivf(np.asarray(model.syn0)) and "
+                    "model.attach_ann(index)")
+            return self._find_synonyms_batch_ann(queries, num, nprobe)
         self.norms  # materialize the cached full-row norms
         out: List[List[Tuple[str, float]]] = []
         k = min(num + 1, self.num_words)
@@ -268,6 +301,41 @@ class Word2VecModel:
                         continue
                     res.append((w, float(s)))
                 out.append(res[:num])
+        return out
+
+    def _find_synonyms_batch_ann(
+        self, queries: Sequence[Union[str, np.ndarray]], num: int,
+        nprobe: Optional[int] = None) -> List[List[Tuple[str, float]]]:
+        """The ANN arm of :meth:`find_synonyms_batch`: host-side probe over
+        the attached index. Word queries read their vector from the index's
+        own normalized copy (no device gather); vector queries are
+        normalized by the index (cosine is scale-invariant)."""
+        index = self._ann
+        words: List[Optional[str]] = []
+        rows: List[np.ndarray] = []
+        for q in queries:
+            if isinstance(q, str):
+                idx = self.vocab.get(q)
+                if idx < 0:
+                    raise KeyError(f"{q} not in vocabulary")
+                words.append(q)
+                rows.append(index.vector(idx))
+            else:
+                words.append(None)
+                rows.append(np.asarray(q, np.float32))
+        k = min(num + 1, self.num_words)
+        scores, idxs = index.search(np.stack(rows), k, nprobe)
+        out: List[List[Tuple[str, float]]] = []
+        for word, srow, irow in zip(words, scores, idxs):
+            res: List[Tuple[str, float]] = []
+            for i, s in zip(irow, srow):
+                if i < 0:
+                    break  # fewer candidates than k in the probed cells
+                w = self.vocab.words[int(i)]
+                if w == word:
+                    continue
+                res.append((w, float(s)))
+            out.append(res[:num])
         return out
 
     def analogy(self, a: str, b: str, c: str, num: int = 10) -> List[Tuple[str, float]]:
@@ -451,6 +519,7 @@ class Word2VecModel:
         self._full0 = None  # type: ignore[assignment]
         self._full1 = None
         self._norms = None
+        self._ann = None
         self._stopped = True
 
 

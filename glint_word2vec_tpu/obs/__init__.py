@@ -17,7 +17,9 @@ Seven layers, each usable alone, all off by default and zero-cost when off:
 - :mod:`.blackbox` — the flight recorder: bounded rings of recent telemetry
   dumped atomically to ``<telemetry_path>.blackbox.json`` on fit death.
 - :mod:`.statusd` — the read-only live-inspection HTTP endpoint
-  (``config.status_port``): JSON + Prometheus gauges for a running fit.
+  (``config.status_port``): JSON + Prometheus gauges for a running fit;
+  the serving tier reuses it with the ``glint_serve_*`` renderer
+  (:func:`.statusd.serve_prometheus_text`, docs/serving.md).
 """
 
 from glint_word2vec_tpu.obs.blackbox import FlightRecorder
@@ -32,7 +34,11 @@ from glint_word2vec_tpu.obs.schema import (
 )
 from glint_word2vec_tpu.obs.sink import TelemetrySink
 from glint_word2vec_tpu.obs.spans import Tracer, default_tracer
-from glint_word2vec_tpu.obs.statusd import StatusServer, prometheus_text
+from glint_word2vec_tpu.obs.statusd import (
+    StatusServer,
+    prometheus_text,
+    serve_prometheus_text,
+)
 from glint_word2vec_tpu.obs.watch import NormWatchdog
 
 __all__ = [
@@ -41,4 +47,5 @@ __all__ = [
     "validate_blackbox", "validate_blackbox_file",
     "TelemetrySink", "Tracer", "default_tracer", "NormWatchdog",
     "FlightRecorder", "PhaseAccumulator", "StatusServer", "prometheus_text",
+    "serve_prometheus_text",
 ]

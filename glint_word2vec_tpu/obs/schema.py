@@ -84,6 +84,33 @@ KINDS: Dict[str, Dict[str, tuple]] = {
         "dispatch_s_total": _NUM,
         "watchdog_fires": (int,),
     },
+    # --- serving-tier record kinds (serve/service.py; ADDITIVE under the
+    # schema evolution rule, like "recovery": brand-new kinds, no existing
+    # field moved — archived v1 training logs keep validating) ---
+    "serve_start": {
+        "checkpoint": (str,),    # path served ("<in-memory>" for model=)
+        "vocab_size": (int,),
+        "vector_size": (int,),
+        # optional: "ann" (the built index's stats dict incl. recall)
+    },
+    "serve_reload": {
+        "vocab_size": (int,),    # of the NEWLY installed model
+        "reloads": (int,),       # total hot-reloads AFTER this one
+        "load_seconds": _NUM,    # background load + index build wall time
+    },
+    "serve_stats": {
+        "submitted": (int,),
+        "refused": (int,),       # 429-style backpressure refusals
+        "batches": (int,),
+        "queue_depth": (int,),
+        "reloads": (int,),
+        # optional: "latency_ms", "occupancy_mean", "ann"
+    },
+    "serve_end": {
+        "submitted": (int,),
+        "refused": (int,),
+        "reloads": (int,),
+    },
 }
 
 _COMMON = {"schema": (int,), "kind": (str,), "t": _NUM}
@@ -107,6 +134,18 @@ KINDS_OPTIONAL: Dict[str, Dict[str, tuple]] = {
     "run_end": {
         "phases": (dict,),       # cumulative per-phase rollup
         "spans": (dict,),        # tracer span summary
+    },
+    "serve_start": {
+        "ann": (dict,),          # IVF build stats (centroids, nprobe,
+                                 # recall_at_10, build_seconds)
+    },
+    "serve_reload": {
+        "ann": (dict,),
+    },
+    "serve_stats": {
+        "latency_ms": (dict,),   # p50/p95/p99 over the recent-latency ring
+        "occupancy_mean": _NUM,  # mean requests per dispatched batch
+        "ann": (dict,),
     },
 }
 

@@ -41,6 +41,19 @@ from typing import Callable, Optional
 logger = logging.getLogger("glint_word2vec_tpu")
 
 
+def _gauge(lines: list, name: str, value, labels: str = "") -> None:
+    """Append one gauge sample (``# TYPE`` + sample line) to ``lines`` —
+    the shared rendering rule of BOTH exposition surfaces (trainer
+    ``glint_*`` and serving ``glint_serve_*``); None skips, bools render
+    as 0/1."""
+    if value is None or isinstance(value, bool):
+        value = float(bool(value)) if isinstance(value, bool) else None
+    if value is None:
+        return
+    lines.append(f"# TYPE {name} gauge")
+    lines.append(f"{name}{labels} {float(value):g}")
+
+
 def prometheus_text(snap: dict) -> str:
     """Render a status snapshot's scalar gauges in Prometheus text format.
 
@@ -49,15 +62,10 @@ def prometheus_text(snap: dict) -> str:
     ``glint_norm_<channel>{matrix="syn0"|"syn1"}``; per-phase rollups become
     ``glint_phase_seconds_total{phase=...}`` / ``glint_phase_count{phase=...}``.
     """
-    lines = []
+    lines: list = []
 
     def gauge(name: str, value, labels: str = "") -> None:
-        if value is None or isinstance(value, bool):
-            value = float(bool(value)) if isinstance(value, bool) else None
-        if value is None:
-            return
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name}{labels} {float(value):g}")
+        _gauge(lines, name, value, labels)
 
     for field in ("global_step", "words", "pairs_trained", "pairs_per_sec",
                   "alpha", "lr_scale", "recoveries", "rollbacks",
@@ -81,9 +89,38 @@ def prometheus_text(snap: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def serve_prometheus_text(snap: dict) -> str:
+    """Render a SERVING snapshot (serve.EmbeddingService.status_snapshot) in
+    Prometheus text format — the ``glint_serve_*`` names (stable contract,
+    docs/serving.md): batcher counters/gauges, latency quantiles over the
+    recent ring, hot-reload counts, and the live index's measured recall."""
+    lines: list = []
+
+    def gauge(name: str, value, labels: str = "") -> None:
+        _gauge(lines, name, value, labels)
+
+    gauge("glint_serve_up", 1.0 if snap.get("status") == "serving" else 0.0)
+    for field in ("submitted", "refused", "completed", "errors", "batches",
+                  "reloads", "models_released"):
+        gauge(f"glint_serve_{field}_total", snap.get(field))
+    for field in ("queue_depth", "occupancy_mean", "vocab_size",
+                  "load_seconds"):
+        gauge(f"glint_serve_{field}", snap.get(field))
+    lat = snap.get("latency_ms") or {}
+    for q in ("p50", "p95", "p99"):
+        if q in lat:
+            gauge("glint_serve_latency_ms", lat[q], f'{{quantile="{q}"}}')
+    ann = snap.get("ann") or {}
+    for field in ("recall_at_10", "nprobe", "centroids", "build_seconds"):
+        if field in ann:
+            gauge(f"glint_serve_ann_{field}", ann[field])
+    return "\n".join(lines) + "\n"
+
+
 class _Handler(BaseHTTPRequestHandler):
     # set per-server via the factory in StatusServer.start
     snapshot_fn: Callable[[], dict]
+    metrics_fn: Callable[[dict], str]
 
     def _send(self, code: int, body: bytes, ctype: str) -> None:
         self.send_response(code)
@@ -99,7 +136,7 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(self.snapshot_fn()).encode()
                 self._send(200, body, "application/json")
             elif path == "/metrics":
-                body = prometheus_text(self.snapshot_fn()).encode()
+                body = self.metrics_fn(self.snapshot_fn()).encode()
                 self._send(200, body,
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
@@ -116,9 +153,13 @@ class _Handler(BaseHTTPRequestHandler):
 class StatusServer:
     """One localhost HTTP server serving a snapshot callable, read-only."""
 
-    def __init__(self, port: int, snapshot_fn: Callable[[], dict]):
+    def __init__(self, port: int, snapshot_fn: Callable[[], dict],
+                 metrics_fn: Optional[Callable[[dict], str]] = None):
         self._requested_port = int(port)
         self._snapshot_fn = snapshot_fn
+        # /metrics renderer: the trainer gauges by default; the serving
+        # tier passes serve_prometheus_text (glint_serve_* names)
+        self._metrics_fn = metrics_fn or prometheus_text
         self._server: Optional[HTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -130,7 +171,8 @@ class StatusServer:
 
     def start(self) -> "StatusServer":
         handler = type("_BoundHandler", (_Handler,),
-                       {"snapshot_fn": staticmethod(self._snapshot_fn)})
+                       {"snapshot_fn": staticmethod(self._snapshot_fn),
+                        "metrics_fn": staticmethod(self._metrics_fn)})
         self._server = HTTPServer(("127.0.0.1", self._requested_port), handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="glint-statusd",

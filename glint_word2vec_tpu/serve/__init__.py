@@ -1,0 +1,34 @@
+"""Production serving tier (docs/serving.md; ROADMAP item 1).
+
+Four layers, composable alone or through :class:`EmbeddingService`:
+
+- :mod:`.batcher` — bounded-queue, deadline-based micro-batcher that
+  coalesces concurrent queries into one device dispatch, with 429-style
+  fast refusal as backpressure.
+- :mod:`.ann` — IVF (coarse k-means + inverted lists) approximate top-k
+  over the trained matrix, built at load/publish time, ``nprobe``-tunable,
+  with recall@k measured against the exact oracle at build.
+- :mod:`.reload` — the swap-window-safe loader (single owner of the retry
+  logic), the lease-counted swappable serving handle, and the
+  checkpoint-publish watcher: zero-downtime hot-reload off the trainer's
+  atomic ``.tmp-*``/``.old-*`` swap protocol.
+- :mod:`.service` — the assembled service: batched exact/ANN queries,
+  hot-reload, ``serve_*`` telemetry records and ``glint_serve_*``
+  Prometheus gauges riding the existing obs layer.
+"""
+
+from glint_word2vec_tpu.serve.ann import IvfIndex, auto_centroids, auto_nprobe, build_ivf
+from glint_word2vec_tpu.serve.batcher import BatchingScheduler, ServerOverloaded
+from glint_word2vec_tpu.serve.reload import (
+    CheckpointWatcher,
+    ServingHandle,
+    load_with_retry,
+)
+from glint_word2vec_tpu.serve.service import EmbeddingService
+
+__all__ = [
+    "IvfIndex", "build_ivf", "auto_centroids", "auto_nprobe",
+    "BatchingScheduler", "ServerOverloaded",
+    "CheckpointWatcher", "ServingHandle", "load_with_retry",
+    "EmbeddingService",
+]

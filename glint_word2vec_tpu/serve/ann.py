@@ -1,0 +1,283 @@
+"""IVF approximate-nearest-neighbor index over the trained embedding matrix.
+
+The serving tier's fast arm (ROADMAP item 1): exact ``find_synonyms`` is a
+full [V, D] matvec + top-k per batch — the right oracle, the wrong steady
+state for millions-of-users traffic. This index buys a tunable
+compute-vs-recall trade the classic IVF way:
+
+- **build** (at load/checkpoint-publish time): unit-normalize the rows
+  (cosine == dot on the unit sphere; zero-norm sharding-padding rows stay
+  zero and can never enter a top-k), k-means a sampled subset into
+  ``num_centroids`` coarse cells (seeded Lloyd iterations — deterministic:
+  same matrix + seed → the same index), then assign every row to its
+  nearest centroid, stored as one CSR-style inverted-list layout
+  (``offsets [C+1]`` + ``rows [V]``);
+- **search**: score the query against the C centroids, visit only the
+  ``nprobe`` nearest cells, and rank the candidate rows exactly — the
+  scanned fraction is ~``nprobe / C`` of the vocabulary instead of 1.0;
+- **recall is measured, not assumed**: the build samples rows as queries
+  and scores the index against the EXACT full-scan oracle on the same
+  normalized matrix; ``stats["recall_at_10"]`` travels with the index, so
+  a geometry that breaks IVF's clustering assumption (e.g. a post-blowup
+  matrix) is visible at publish time — and tools/eval_quality.py records
+  the same number into EVAL_RUNS rows.
+
+Host-resident by design: the index holds ONE float32 normalized copy of
+the matrix plus O(V) int32 list structure. Search is numpy (BLAS matmuls
+over small candidate sets) — it deliberately does not touch the device, so
+ANN queries never contend with the exact arm's device dispatches or a
+co-located trainer's collectives. The exact sharded top-k
+(models/word2vec.py) remains the ground-truth oracle.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+# chunk sizes bounding host scratch: assignment [chunk, C] and the exact-
+# oracle [chunk, V] score blocks stay under ~256 MB each
+_ASSIGN_BLOCK_BYTES = 256 << 20
+_ORACLE_BLOCK_BYTES = 256 << 20
+
+
+def _normalize_rows(m: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(unit rows, norms); zero-norm rows stay zero (cosine 0 everywhere —
+    the same masking rule as the exact path's zero-norm handling)."""
+    m = np.ascontiguousarray(m, dtype=np.float32)
+    norms = np.linalg.norm(m, axis=1)
+    out = m / np.maximum(norms, 1e-12)[:, None]
+    return out, norms
+
+
+def _argmax_rows(x: np.ndarray, centroids: np.ndarray) -> np.ndarray:
+    """Nearest-centroid id per row of ``x`` (both unit-normalized), with the
+    [chunk, C] score block bounded."""
+    C = centroids.shape[0]
+    chunk = max(1, _ASSIGN_BLOCK_BYTES // max(C * 4, 1))
+    out = np.empty(x.shape[0], np.int32)
+    for lo in range(0, x.shape[0], chunk):
+        out[lo:lo + chunk] = np.argmax(
+            x[lo:lo + chunk] @ centroids.T, axis=1).astype(np.int32)
+    return out
+
+
+def _topk_desc(scores: np.ndarray, k: int) -> np.ndarray:
+    """Indices of the k largest entries, sorted descending by score (ties:
+    ascending index — stable across runs)."""
+    n = scores.shape[0]
+    if k >= n:
+        cand = np.arange(n)
+    else:
+        cand = np.argpartition(scores, n - k)[n - k:]
+    return cand[np.lexsort((cand, -scores[cand]))][:k]
+
+
+class IvfIndex:
+    """Built inverted-file index; see :func:`build_ivf`.
+
+    Storage is the PACKED layout: the normalized matrix is reordered so each
+    inverted list is one contiguous row block (``_packed[offsets[c]:
+    offsets[c+1]]`` is cell ``c``). Probing a cell is then a sequential
+    matmul over its block — the naive gather of ~nprobe/C·V scattered rows
+    is DRAM-latency-bound and measured 5-10x slower at V ≥ 400k on this
+    host class. ``_ids`` maps packed positions back to original row ids;
+    ``_row_pos`` is the inverse (for :meth:`vector`)."""
+
+    def __init__(self, centroids: np.ndarray, offsets: np.ndarray,
+                 packed: np.ndarray, ids: np.ndarray, row_pos: np.ndarray,
+                 nprobe: int, stats: Dict):
+        self._centroids = centroids      # [C, D] unit rows
+        self._offsets = offsets          # [C + 1] int64
+        self._packed = packed            # [V, D] unit rows, list order
+        self._ids = ids                  # [V] int32: packed pos -> row id
+        self._row_pos = row_pos          # [V] int64: row id -> packed pos
+        self.nprobe = int(nprobe)
+        self.stats = stats
+
+    @property
+    def num_centroids(self) -> int:
+        return int(self._centroids.shape[0])
+
+    @property
+    def num_rows(self) -> int:
+        return int(self._packed.shape[0])
+
+    def vector(self, row: int) -> np.ndarray:
+        """The indexed (unit-normalized) vector of one row — lets word
+        queries reuse the host copy instead of a device gather."""
+        return self._packed[self._row_pos[row]]
+
+    def search(self, queries: np.ndarray, k: int,
+               nprobe: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Top-``k`` cosine rows per query over the probed cells.
+
+        Returns ``(scores [Q, k], row_ids [Q, k])``; slots past the
+        candidate count (possible only at tiny nprobe on tiny lists) carry
+        ``(-inf, -1)``. ``nprobe`` overrides the index default; clamped to
+        the centroid count (``nprobe >= C`` degrades to an exact scan and
+        is the recall-1.0 reference point)."""
+        q, _ = _normalize_rows(np.atleast_2d(np.asarray(queries, np.float32)))
+        C = self.num_centroids
+        npr = min(int(nprobe) if nprobe else self.nprobe, C)
+        npr = max(npr, 1)
+        cscore = q @ self._centroids.T                       # [Q, C]
+        Q = q.shape[0]
+        off = self._offsets
+        scores = np.full((Q, k), -np.inf, np.float32)
+        idx = np.full((Q, k), -1, np.int64)
+        for r in range(Q):
+            # probe cells best-first, and past the nprobe budget KEEP
+            # probing until the candidate pool covers k (a tiny/uneven cell
+            # must not starve the result below the requested top-k — the
+            # serve-reload chaos phase caught exactly that at toy vocab)
+            order = np.argsort(-cscore[r], kind="stable")
+            parts, pos_parts, got = [], [], 0
+            for j, c in enumerate(order):
+                if j >= npr and got >= k:
+                    break
+                lo, hi = off[c], off[c + 1]
+                if hi == lo:
+                    continue
+                # one contiguous matvec per probed cell (packed layout)
+                parts.append(self._packed[lo:hi] @ q[r])
+                pos_parts.append(np.arange(lo, hi))
+                got += hi - lo
+            if not parts:
+                continue
+            s = np.concatenate(parts)
+            pos = np.concatenate(pos_parts)
+            top = _topk_desc(s, min(k, s.size))
+            scores[r, :top.size] = s[top]
+            idx[r, :top.size] = self._ids[pos[top]]
+        return scores, idx
+
+    def measure_recall(self, query_rows: np.ndarray, k: int = 10,
+                       nprobe: Optional[int] = None) -> float:
+        """recall@k of this index vs the EXACT full-scan oracle on the same
+        normalized matrix, querying by row id (self excluded on both arms —
+        the serving semantics)."""
+        qpos = self._row_pos[np.asarray(query_rows)]
+        q = self._packed[qpos]
+        _, ann_i = self.search(q, k + 1, nprobe)
+        V = self.num_rows
+        chunk = max(1, _ORACLE_BLOCK_BYTES // max(V * 4, 1))
+        hits, total = 0, 0
+        for lo in range(0, q.shape[0], chunk):
+            block = q[lo:lo + chunk] @ self._packed.T        # [chunk, V]
+            for r in range(block.shape[0]):
+                qi = int(query_rows[lo + r])
+                exact = [int(self._ids[p])
+                         for p in _topk_desc(block[r], k + 1)
+                         if self._ids[p] != qi][:k]
+                ann = [i for i in ann_i[lo + r] if i >= 0 and i != qi][:k]
+                hits += len(set(exact) & set(ann))
+                total += len(exact)
+        return hits / max(total, 1)
+
+
+def auto_centroids(num_rows: int) -> int:
+    """The AUTO cell count: ~4·sqrt(V), clamped so every cell averages ≥ 8
+    rows and the centroid scan stays tiny next to the scan it replaces."""
+    return max(1, min(int(round(4 * math.sqrt(max(num_rows, 1)))),
+                      max(num_rows // 8, 1), 4096))
+
+
+def auto_nprobe(num_centroids: int) -> int:
+    """The AUTO probe width: ~1/12 of the cells (≈8% of the vocabulary
+    scanned) — the measured recall ≥ 0.95 operating point on clustered
+    embedding geometry (tools/servebench.py); tune per deployment."""
+    return max(1, -(-num_centroids // 12))
+
+
+def build_ivf(
+    matrix: np.ndarray,
+    num_centroids: int = 0,
+    nprobe: int = 0,
+    seed: int = 0,
+    kmeans_iters: int = 4,
+    train_sample: int = 65536,
+    recall_queries: int = 256,
+    recall_k: int = 10,
+    measure_recall: bool = True,
+) -> IvfIndex:
+    """Build an :class:`IvfIndex` from a [V, D] embedding matrix (pass the
+    UNPADDED ``model.syn0``; sharding padding would only add zero rows).
+
+    ``num_centroids``/``nprobe`` 0 = AUTO (:func:`auto_centroids` /
+    :func:`auto_nprobe` — the ``serve_ann_centroids``/``serve_ann_nprobe``
+    config knobs carry the same 0-is-AUTO convention). ``measure_recall``
+    scores the built index against the exact oracle on ``recall_queries``
+    sampled rows; the result rides ``index.stats`` (and, from there,
+    servebench JSON lines and EVAL_RUNS rows)."""
+    t0 = time.perf_counter()
+    normed, norms = _normalize_rows(np.asarray(matrix, np.float32))
+    V = normed.shape[0]
+    nonzero = np.flatnonzero(norms > 0)
+    C = int(num_centroids) if num_centroids else auto_centroids(V)
+    C = max(1, min(C, max(nonzero.size, 1)))
+    rng = np.random.default_rng(seed)
+
+    if nonzero.size:
+        if nonzero.size > train_sample:
+            train = rng.choice(nonzero, size=train_sample, replace=False)
+        else:
+            train = nonzero
+        X = normed[train]
+        centroids = X[rng.choice(X.shape[0], size=C, replace=False)].copy()
+        for _ in range(max(kmeans_iters, 1)):
+            assign = _argmax_rows(X, centroids)
+            sums = np.zeros_like(centroids)
+            np.add.at(sums, assign, X)
+            counts = np.bincount(assign, minlength=C)
+            live = counts > 0
+            sums[live] /= counts[live, None]
+            dead = np.flatnonzero(~live)
+            if dead.size:
+                # re-seed empty cells from random training rows so every
+                # cell stays live (classic Lloyd repair, deterministic)
+                sums[dead] = X[rng.choice(X.shape[0], size=dead.size)]
+            centroids, _ = _normalize_rows(sums)
+    else:
+        # degenerate all-zero matrix: one empty-ish cell, exact fallback
+        centroids = np.zeros((1, normed.shape[1]), np.float32)
+        C = 1
+
+    assign_all = _argmax_rows(normed, centroids)
+    counts = np.bincount(assign_all, minlength=C)
+    offsets = np.zeros(C + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    ids = np.argsort(assign_all, kind="stable").astype(np.int32)
+    packed = np.ascontiguousarray(normed[ids])   # list-contiguous layout
+    row_pos = np.empty(V, np.int64)
+    row_pos[ids] = np.arange(V)
+
+    npr = int(nprobe) if nprobe else auto_nprobe(C)
+    stats: Dict = {
+        "centroids": C,
+        "nprobe": min(npr, C),
+        "rows": V,
+        "mean_list_len": round(float(counts.mean()), 2) if C else 0.0,
+        "max_list_len": int(counts.max()) if C else 0,
+    }
+    index = IvfIndex(centroids, offsets, packed, ids, row_pos,
+                     min(npr, C), stats)
+    if measure_recall and nonzero.size > recall_k:
+        probes = rng.choice(nonzero,
+                            size=min(recall_queries, nonzero.size),
+                            replace=False)
+        stats["recall_at_10" if recall_k == 10 else f"recall_at_{recall_k}"] \
+            = round(index.measure_recall(probes, k=recall_k), 4)
+        stats["recall_queries"] = int(probes.size)
+    stats["build_seconds"] = round(time.perf_counter() - t0, 3)
+    logger.info("IVF index built: V=%d C=%d nprobe=%d recall@%d=%s in %.2fs",
+                V, C, stats["nprobe"], recall_k,
+                stats.get(f"recall_at_{recall_k}"), stats["build_seconds"])
+    return index

@@ -1,0 +1,240 @@
+"""Request batching scheduler: a bounded queue + deadline micro-batcher.
+
+The serving economics this exists for (PERF.md §6, ROADMAP item 1): one
+exact synonym query at V=1M costs 230-375 ms through a thin host→device
+link, but 64 queries coalesced into ONE device dispatch cost 13-16 ms
+total — the per-query round trip dominates, not the math. This scheduler
+turns N concurrent callers into that one dispatch:
+
+- ``submit()`` enqueues a request and blocks the calling thread until its
+  result is ready (clients are threads — the stdin CLI, the bench harness's
+  closed-loop clients, the chaos storm);
+- one worker thread pops the queue and coalesces up to ``max_batch``
+  requests, waiting at most ``max_delay_ms`` past the FIRST request's
+  arrival (latency is bounded by the deadline, throughput by the batch cap);
+- the whole batch goes to the ``handler`` callable in one call; the handler
+  returns one result per request (an ``Exception`` instance marks a
+  per-request failure — an OOV word must fail ITS caller, not the batch);
+- **backpressure is a fast refusal, never unbounded memory**: a full queue
+  raises :class:`ServerOverloaded` to the caller immediately (the 429-style
+  contract) instead of queueing into latency collapse.
+
+Determinism note (graftlint R1): the worker thread is a sanctioned owner —
+it only ORDERS request/response pairing (each caller gets exactly its own
+result back) and is read-only on model parameters; it never produces or
+orders training data, so the worker-count determinism contract is
+untouched. Batch COMPOSITION is timing-dependent by design (that is what a
+micro-batcher is); per-request results are not, because the handler maps
+item i to result i.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+
+class ServerOverloaded(RuntimeError):
+    """Admission refused: the bounded queue is full. The serving analog of
+    HTTP 429 — callers should shed or retry with backoff; the server never
+    buffers unboundedly."""
+
+    status = 429
+
+
+class _Ticket:
+    """One in-flight request: payload in, result/error out, an event the
+    submitting thread parks on."""
+
+    __slots__ = ("payload", "enqueued", "done", "result", "error")
+
+    def __init__(self, payload: Any):
+        self.payload = payload
+        self.enqueued = time.monotonic()
+        self.done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class BatchingScheduler:
+    """Deadline-based micro-batcher over a bounded queue (module doc)."""
+
+    def __init__(
+        self,
+        handler: Callable[[List[Any]], Sequence[Any]],
+        max_batch: int = 64,
+        max_delay_ms: float = 2.0,
+        max_queue: int = 256,
+        name: str = "glint-serve-batcher",
+    ):
+        if max_batch <= 0:
+            raise ValueError(f"max_batch must be positive but got {max_batch}")
+        if max_delay_ms < 0:
+            raise ValueError(
+                f"max_delay_ms must be nonnegative but got {max_delay_ms}")
+        if max_queue <= 0:
+            raise ValueError(f"max_queue must be positive but got {max_queue}")
+        self._handler = handler
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.max_queue = int(max_queue)
+        self._name = name
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        # counters (all mutated under _cv)
+        self._submitted = 0
+        self._refused = 0
+        self._completed = 0
+        self._errors = 0
+        self._batches = 0
+        self._batched_items = 0
+        # recent end-to-end latencies (seconds); deque append is atomic, so
+        # submitters record lock-free and stats() snapshots a copy
+        self._latencies: collections.deque = collections.deque(maxlen=4096)
+
+    # -- lifecycle ---------------------------------------------------------------------
+
+    def start(self) -> "BatchingScheduler":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name=self._name, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Drain-and-stop: requests already admitted are still served (the
+        worker keeps batching until the queue is empty), new submits are
+        refused."""
+        with self._cv:
+            if self._stopping:
+                return
+            self._stopping = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # -- client side -------------------------------------------------------------------
+
+    def submit_async(self, payload: Any) -> _Ticket:
+        """Enqueue one request; returns the ticket to :meth:`wait` on.
+        Raises :class:`ServerOverloaded` when the bounded queue is full."""
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("scheduler is stopped")
+            if len(self._q) >= self.max_queue:
+                self._refused += 1
+                raise ServerOverloaded(
+                    f"admission queue full ({self.max_queue} waiting)")
+            t = _Ticket(payload)
+            self._q.append(t)
+            self._submitted += 1
+            self._cv.notify_all()
+        return t
+
+    def wait(self, ticket: _Ticket, timeout: float = 60.0) -> Any:
+        """Block until the ticket's batch completed; re-raise its per-request
+        error in the caller's thread."""
+        if not ticket.done.wait(timeout):
+            raise TimeoutError(f"request not served within {timeout:g}s")
+        self._latencies.append(time.monotonic() - ticket.enqueued)
+        if ticket.error is not None:
+            raise ticket.error
+        return ticket.result
+
+    def submit(self, payload: Any, timeout: float = 60.0) -> Any:
+        """Blocking submit: enqueue + wait (the one-call client surface)."""
+        return self.wait(self.submit_async(payload), timeout)
+
+    # -- worker side -------------------------------------------------------------------
+
+    def _collect(self) -> Optional[List[_Ticket]]:
+        """Pop one batch: block for the first request, then coalesce until
+        ``max_batch`` or ``max_delay_ms`` past the first arrival. None =
+        stopped and drained."""
+        with self._cv:
+            while not self._q and not self._stopping:
+                self._cv.wait()
+            if not self._q:
+                return None  # stopping, queue drained
+            batch = [self._q.popleft()]
+            deadline = batch[0].enqueued + self.max_delay_s
+            while len(batch) < self.max_batch:
+                if self._q:
+                    batch.append(self._q.popleft())
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stopping:
+                    break
+                self._cv.wait(remaining)
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            try:
+                results = self._handler([t.payload for t in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"handler returned {len(results)} results for a "
+                        f"batch of {len(batch)}")
+            except Exception as e:  # noqa: BLE001 — delivered to each caller
+                with self._cv:
+                    self._batches += 1
+                    self._batched_items += len(batch)
+                    self._errors += len(batch)
+                for t in batch:
+                    t.error = e
+                    t.done.set()
+                continue
+            n_err = 0
+            for t, r in zip(batch, results):
+                if isinstance(r, BaseException):
+                    t.error = r
+                    n_err += 1
+                else:
+                    t.result = r
+            with self._cv:
+                self._batches += 1
+                self._batched_items += len(batch)
+                self._errors += n_err
+                self._completed += len(batch) - n_err
+            for t in batch:
+                t.done.set()
+
+    # -- observability -----------------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Gauge snapshot: counters, queue depth, mean batch occupancy, and
+        p50/p95/p99 end-to-end latency over the recent-latency ring."""
+        with self._cv:
+            snap = {
+                "submitted": self._submitted,
+                "refused": self._refused,
+                "completed": self._completed,
+                "errors": self._errors,
+                "batches": self._batches,
+                "queue_depth": len(self._q),
+                "max_batch": self.max_batch,
+                "max_queue": self.max_queue,
+                "occupancy_mean": (round(self._batched_items / self._batches, 3)
+                                   if self._batches else None),
+            }
+        lats = sorted(self._latencies)
+        if lats:
+            def pct(p: float) -> float:
+                return round(
+                    lats[min(len(lats) - 1, int(p * len(lats)))] * 1000, 3)
+            snap["latency_ms"] = {"p50": pct(0.50), "p95": pct(0.95),
+                                  "p99": pct(0.99), "n": len(lats)}
+        return snap

@@ -1,0 +1,237 @@
+"""Zero-downtime hot-reload: swap-window-safe loading, a lease-counted
+serving handle, and the checkpoint-publish watcher.
+
+The trainer's atomic save protocol (train/checkpoint.py) already gives
+serving a clean publish signal: a completed save replaces the checkpoint
+directory in two renames (``path`` → ``path.old-<pid>``, staged
+``.tmp-*`` → ``path``), so ``<path>/metadata.json`` changes identity
+exactly once per publish — and is briefly ABSENT inside the sub-second
+swap window. This module owns the serving side of that protocol:
+
+- :func:`load_with_retry` — THE single owner of the swap-window retry
+  logic (extracted from tools/serve_checkpoint.py, which now calls this):
+  transient mid-swap failures (missing path, half-written JSON, a
+  metadata/words pair read across the two renames) retry over the window;
+  permanent problems (bad mesh for the shard layout, corrupt arrays)
+  surface immediately.
+- :class:`ServingHandle` — the atomically swappable ``(model, index)``
+  pair with lease counting: a dispatch takes a lease for the whole batch,
+  ``swap()`` installs the new pair instantly for FUTURE batches, and the
+  old model's device buffers are released only when its last in-flight
+  lease drains — no query ever observes a stopped model, no buffer ever
+  leaks past the drain.
+- :class:`CheckpointWatcher` — a poll thread (graftlint R1 sanctioned
+  owner: read-only on params, it only stats a file and invokes the
+  service's reload callback) that detects the publish signal and triggers
+  the background load + index build + swap.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+
+def load_with_retry(path: str, plan=None, attempts: int = 8,
+                    delay: float = 0.25):
+    """Load a checkpoint, absorbing the trainer's atomic-swap window.
+
+    The swap has a sub-second window where the checkpoint path is
+    mid-rename / the old dir is being removed; a load landing inside it
+    sees FileNotFoundError or a half-listed directory. Retry over the
+    window instead of bouncing the error to the caller. Only the transient
+    swap-window failures retry: a missing path, half-written JSON, or a
+    metadata/words pair read across the two renames (surfaces as the
+    loader's vocab_size-mismatch ValueError). A digest-mismatch
+    CheckpointCorruptError also retries: under rapid publishing a load can
+    read publish N's metadata and publish N+1's arrays (two ATOMIC saves,
+    one straddling reader — observed live in the serve-reload chaos
+    phase), indistinguishable from bit rot on one attempt but healed on
+    retry; REAL corruption keeps failing and still raises once the budget
+    is spent. Permanent problems (bad mesh for the shard layout) surface
+    immediately."""
+    from glint_word2vec_tpu.models.word2vec import Word2VecModel
+    from glint_word2vec_tpu.train.checkpoint import CheckpointCorruptError
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        try:
+            return Word2VecModel.load(path, plan=plan)
+        except (FileNotFoundError, json.JSONDecodeError,
+                CheckpointCorruptError) as e:
+            last = e
+        except ValueError as e:
+            if "vocab_size" not in str(e) and "words" not in str(e):
+                raise
+            last = e
+        if i == attempts - 1:
+            raise last
+        time.sleep(delay)
+
+
+def publish_signature(checkpoint_path: str) -> Optional[Tuple[int, int, int]]:
+    """The checkpoint's current publish identity (``metadata.json``
+    mtime/inode/size), or None while absent / mid-swap. Capture this
+    BEFORE loading and record it as served AFTER the load succeeds — a
+    publish landing during a slow load/index build then still differs
+    from the recorded signature and re-fires (capturing after the load
+    would permanently swallow it)."""
+    try:
+        st = os.stat(os.path.join(checkpoint_path, "metadata.json"))
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_ino, st.st_size)
+
+
+class _Slot:
+    """One (model, index) generation plus its lease count. ``refs`` starts
+    at 1 — the handle's own reference; ``swap`` drops it."""
+
+    __slots__ = ("model", "index", "refs")
+
+    def __init__(self, model, index):
+        self.model = model
+        self.index = index
+        self.refs = 1
+
+
+class ServingHandle:
+    """Atomically swappable (model, index) with lease-counted release."""
+
+    def __init__(self, model, index=None):
+        self._lock = threading.Lock()
+        self._current: Optional[_Slot] = _Slot(model, index)
+        self.models_released = 0
+        self.swaps = 0
+
+    @contextlib.contextmanager
+    def lease(self) -> Iterator[Tuple[Any, Any]]:
+        """Pin the CURRENT generation for the duration of one batch: the
+        yielded pair stays alive (buffers un-released) until the context
+        exits, even if a swap lands mid-batch."""
+        with self._lock:
+            slot = self._current
+            if slot is None:
+                raise RuntimeError("serving handle is stopped")
+            slot.refs += 1
+        try:
+            yield slot.model, slot.index
+        finally:
+            self._release(slot)
+
+    def _release(self, slot: _Slot) -> None:
+        with self._lock:
+            slot.refs -= 1
+            drained = slot.refs == 0
+            if drained:
+                self.models_released += 1
+        if drained:
+            # outside the lock: stop() deletes device buffers
+            try:
+                slot.model.stop()
+            except Exception:  # noqa: BLE001 — release is best-effort
+                logger.warning("old serving model release failed",
+                               exc_info=True)
+
+    def swap(self, model, index=None) -> None:
+        """Install a new generation. Future leases see the new pair
+        immediately; the old generation is released when its in-flight
+        leases drain (possibly right here, if none are in flight)."""
+        new = _Slot(model, index)
+        with self._lock:
+            old = self._current
+            if old is None:
+                raise RuntimeError("serving handle is stopped")
+            self._current = new
+            self.swaps += 1
+        self._release(old)  # drop the handle's own reference
+
+    def stop(self) -> None:
+        """Release the current generation (after in-flight leases drain)
+        and refuse further leases. Idempotent."""
+        with self._lock:
+            old = self._current
+            self._current = None
+        if old is not None:
+            self._release(old)
+
+    def detach(self) -> None:
+        """Refuse further leases WITHOUT releasing the current model — for
+        callers that own the model's lifecycle themselves (a service built
+        over an in-memory ``model=`` keeps the caller's buffers alive; the
+        bench reuses one matrix across service arms)."""
+        with self._lock:
+            self._current = None
+
+
+class CheckpointWatcher:
+    """Publish-signal poller: fires ``on_publish()`` when the checkpoint's
+    ``metadata.json`` changes identity (mtime/inode/size), i.e. once per
+    completed trainer save. The mid-swap ABSENT state is not a signal —
+    the next poll after the swap completes sees the new identity."""
+
+    def __init__(self, checkpoint_path: str,
+                 on_publish: Callable[[], None],
+                 poll_s: float = 0.5,
+                 loaded_signature: Optional[Tuple[int, int, int]] = None,
+                 name: str = "glint-serve-watcher"):
+        """``loaded_signature`` is the :func:`publish_signature` captured
+        BEFORE the caller loaded the model it is now serving — a publish
+        that landed during that load then differs and fires on the first
+        poll. None (nothing served yet) makes the first poll fire on any
+        existing checkpoint."""
+        if poll_s <= 0:
+            raise ValueError(f"poll_s must be positive but got {poll_s}")
+        self._path = checkpoint_path
+        self._on_publish = on_publish
+        self._poll_s = float(poll_s)
+        self._name = name
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._loaded_sig = loaded_signature
+
+    def _signature(self) -> Optional[Tuple[int, int, int]]:
+        return publish_signature(self._path)
+
+    def mark_loaded(self, signature: Optional[Tuple[int, int, int]]) -> None:
+        """Record ``signature`` (captured BEFORE the explicit reload that
+        just succeeded — see :func:`publish_signature`) as served, so the
+        watcher does not re-fire on it."""
+        self._loaded_sig = signature
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name=self._name, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            sig = self._signature()
+            if sig is None or sig == self._loaded_sig:
+                continue
+            try:
+                self._on_publish()
+            except Exception:  # noqa: BLE001 — a failed reload must not
+                # kill serving; the CURRENT model keeps answering and the
+                # next poll retries (a newer publish may fix it)
+                logger.warning("hot-reload failed; still serving the "
+                               "previous model", exc_info=True)
+                continue
+            # record the signature captured BEFORE the load: if the trainer
+            # published again mid-load, the next poll re-fires
+            self._loaded_sig = sig

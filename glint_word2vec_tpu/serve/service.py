@@ -1,0 +1,334 @@
+"""The embedding service: batching + ANN + hot-reload behind one handle.
+
+The production serving tier ROADMAP item 1 names (the reference's mode-B
+standalone-PS-cluster deployment, PAPER.md §G1, re-imagined for the
+checkpoint-serving design): ONE object that
+
+- loads a checkpoint through the swap-window-safe single owner
+  (:func:`.reload.load_with_retry`),
+- builds the IVF ANN index at load/publish time (:mod:`.ann`), keeping the
+  exact sharded top-k as the ground-truth oracle arm,
+- coalesces concurrent queries into batched dispatches with bounded-queue
+  backpressure (:mod:`.batcher`),
+- hot-reloads on the trainer's publish signal with zero downtime
+  (:mod:`.reload` — in-flight batches finish on the old model, its buffers
+  release when the last lease drains),
+- and rides the existing obs layer: additive ``serve_*`` record kinds into
+  the telemetry sink (obs/schema.py) and ``glint_serve_*`` Prometheus
+  gauges through statusd (obs/statusd.serve_prometheus_text).
+
+Knob resolution: the ``serve_*`` fields on :class:`Word2VecConfig` (they
+travel with the checkpoint, like every other knob) are the defaults;
+constructor arguments override per process. The trainer never reads them —
+serving is a separate process in the deployment story (tests co-locate for
+convenience; nothing requires it).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from glint_word2vec_tpu.serve.ann import build_ivf
+from glint_word2vec_tpu.serve.batcher import BatchingScheduler
+from glint_word2vec_tpu.serve.reload import (
+    CheckpointWatcher,
+    ServingHandle,
+    load_with_retry,
+    publish_signature,
+)
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+Query = Union[str, np.ndarray]
+
+
+def _knob(model, name: str, override):
+    """Constructor override, else the checkpoint config's serve_* field,
+    else the dataclass default (old checkpoints deserialize with defaults
+    filled in, so getattr always resolves)."""
+    if override is not None:
+        return override
+    return getattr(model.config, name)
+
+
+class EmbeddingService:
+    """Batched, ANN-indexed, hot-reloading synonym/vector service."""
+
+    def __init__(
+        self,
+        checkpoint: Optional[str] = None,
+        model=None,
+        plan=None,
+        ann: bool = True,
+        nprobe: Optional[int] = None,
+        ann_centroids: Optional[int] = None,
+        ann_seed: int = 0,
+        max_batch: Optional[int] = None,
+        max_delay_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        watch: bool = False,
+        reload_poll_s: Optional[float] = None,
+        telemetry_path: str = "",
+        status_port: int = 0,
+    ):
+        # pure argument validation FIRST — nothing acquired yet
+        if (checkpoint is None) == (model is None):
+            raise ValueError("pass exactly one of checkpoint= or model=")
+        if watch and checkpoint is None:
+            raise ValueError("watch=True needs a checkpoint path to poll")
+        self._checkpoint = checkpoint
+        # a checkpoint-loaded model is ours to release on close; an
+        # in-memory model= stays the caller's (handle.detach on close)
+        self._owns_model = checkpoint is not None
+        self._plan = plan
+        self._ann_enabled = bool(ann)
+        self._ann_seed = int(ann_seed)
+        self._batcher = None
+        self._sink = None
+        self._statusd = None
+        self._watcher = None
+        self._handle = None
+        self._closed = False
+        t0 = time.perf_counter()
+        # signature BEFORE the load: a publish landing during the slow
+        # load/index build below must still read as unserved afterwards
+        # (reload.publish_signature has the capture rule)
+        pre_sig = (publish_signature(checkpoint)
+                   if checkpoint is not None else None)
+        if model is None:
+            model = load_with_retry(checkpoint, plan=plan)
+        self._nprobe = (int(nprobe) if nprobe
+                        else _knob(model, "serve_ann_nprobe", None)) or None
+        self._ann_centroids = int(
+            _knob(model, "serve_ann_centroids", ann_centroids))
+        try:
+            index = self._build_index(model)
+            self._handle = ServingHandle(model, index)
+            self._load_seconds = time.perf_counter() - t0
+            self.reloads = 0
+            self._batcher = BatchingScheduler(
+                self._dispatch,
+                max_batch=int(_knob(model, "serve_max_batch", max_batch)),
+                max_delay_ms=float(_knob(model, "serve_max_delay_ms",
+                                         max_delay_ms)),
+                max_queue=int(_knob(model, "serve_queue_depth", queue_depth)),
+            ).start()
+            if telemetry_path:
+                from glint_word2vec_tpu.obs.sink import TelemetrySink
+                self._sink = TelemetrySink(telemetry_path)
+                self._sink.emit("serve_start",
+                                checkpoint=checkpoint or "<in-memory>",
+                                vocab_size=model.num_words,
+                                vector_size=model.vector_size,
+                                **({"ann": index.stats} if index else {}))
+            if status_port:
+                from glint_word2vec_tpu.obs.statusd import (
+                    StatusServer, serve_prometheus_text)
+                self._statusd = StatusServer(
+                    status_port, self.status_snapshot,
+                    metrics_fn=serve_prometheus_text).start()
+            if watch:
+                self._watcher = CheckpointWatcher(
+                    checkpoint, self._on_publish,
+                    poll_s=float(_knob(model, "serve_reload_poll_s",
+                                       reload_poll_s)),
+                    loaded_signature=pre_sig).start()
+        except BaseException:
+            # a failed init must not leak the batcher thread, the bound
+            # status socket, the sink file, or the loaded model's buffers
+            # (the caller has no service reference to close())
+            if self._handle is None:
+                if self._owns_model:
+                    model.stop()
+            self.close()
+            raise
+
+    # -- index / reload ----------------------------------------------------------------
+
+    def _build_index(self, model):
+        if not self._ann_enabled:
+            return None
+        index = build_ivf(np.asarray(model.syn0),
+                          num_centroids=self._ann_centroids,
+                          nprobe=self._nprobe or 0,
+                          seed=self._ann_seed)
+        model.attach_ann(index)
+        return index
+
+    def _load_and_swap(self) -> Any:
+        """Load the newest checkpoint + build its index IN THE BACKGROUND
+        (the current model keeps serving), then atomically swap."""
+        t0 = time.perf_counter()
+        model = load_with_retry(self._checkpoint, plan=self._plan)
+        index = self._build_index(model)
+        self._handle.swap(model, index)
+        self.reloads += 1
+        self._load_seconds = time.perf_counter() - t0
+        if self._sink is not None:
+            self._sink.emit("serve_reload",
+                            vocab_size=model.num_words,
+                            reloads=self.reloads,
+                            load_seconds=round(self._load_seconds, 3),
+                            **({"ann": index.stats} if index else {}))
+        logger.info("hot-reload %d: %d words in %.2fs (in-flight batches "
+                    "finished on the old model)", self.reloads,
+                    model.num_words, self._load_seconds)
+        return model
+
+    def _on_publish(self) -> None:
+        self._load_and_swap()
+
+    def reload_now(self):
+        """Explicit synchronous reload (the CLI ``reload`` op). Returns the
+        new model."""
+        if self._checkpoint is None:
+            raise RuntimeError("in-memory service has no checkpoint to reload")
+        # signature before the load (reload.publish_signature's capture
+        # rule): a publish racing this reload stays visible to the watcher
+        pre_sig = publish_signature(self._checkpoint)
+        model = self._load_and_swap()
+        if self._watcher is not None:
+            self._watcher.mark_loaded(pre_sig)
+        return model
+
+    # -- the batched dispatch (runs on the batcher worker thread) ----------------------
+
+    def _dispatch(self, payloads: List[Tuple]) -> List[Any]:
+        """One coalesced batch under ONE lease: every request in the batch
+        is answered by the same model generation, and a swap landing
+        mid-batch waits for the lease to drain before the old buffers go."""
+        with self._handle.lease() as (model, index):
+            results: List[Any] = [None] * len(payloads)
+            syn_pos: List[int] = []
+            syn_q: List[Query] = []
+            syn_num: List[int] = []
+            for i, p in enumerate(payloads):
+                op = p[0]
+                if op == "syn":
+                    _, q, num = p
+                    if isinstance(q, str) and model.vocab.get(q) < 0:
+                        # per-request failure: an OOV word fails ITS caller,
+                        # never the batch (the batcher re-raises it there)
+                        results[i] = KeyError(f"{q} not in vocabulary")
+                        continue
+                    syn_pos.append(i)
+                    syn_q.append(q)
+                    syn_num.append(int(num))
+                elif op == "vec":
+                    try:
+                        results[i] = model.transform(p[1])
+                    except KeyError as e:
+                        results[i] = e
+                else:
+                    results[i] = ValueError(f"unknown op {op!r}")
+            if syn_pos:
+                kmax = max(syn_num)
+                use_ann = self._ann_enabled and index is not None
+                try:
+                    rows = model.find_synonyms_batch(
+                        syn_q, kmax, ann=use_ann, nprobe=self._nprobe)
+                except Exception as e:  # noqa: BLE001 — delivered per caller
+                    for i in syn_pos:
+                        results[i] = e
+                else:
+                    for i, res, num in zip(syn_pos, rows, syn_num):
+                        results[i] = res[:num]
+            return results
+
+    # -- client surface ----------------------------------------------------------------
+
+    def synonyms(self, query: Query, num: int = 10,
+                 timeout: float = 60.0) -> List[Tuple[str, float]]:
+        return self._batcher.submit(("syn", query, num), timeout)
+
+    def synonyms_batch(self, queries: Sequence[Query], num: int = 10,
+                       timeout: float = 60.0
+                       ) -> List[List[Tuple[str, float]]]:
+        """Submit many queries at once — they coalesce into device-batch-
+        sized dispatches with any other in-flight traffic."""
+        tickets = [self._batcher.submit_async(("syn", q, num))
+                   for q in queries]
+        return [self._batcher.wait(t, timeout) for t in tickets]
+
+    def vector(self, word: str, timeout: float = 60.0) -> np.ndarray:
+        return self._batcher.submit(("vec", word), timeout)
+
+    # -- observability -----------------------------------------------------------------
+
+    def info(self) -> Dict[str, Any]:
+        with self._handle.lease() as (model, index):
+            return {
+                "num_words": model.num_words,
+                "vector_size": model.vector_size,
+                "iteration": (model.train_state.iteration
+                              if model.train_state else None),
+                "finished": (model.train_state.finished
+                             if model.train_state else None),
+                "ann": dict(index.stats) if index else None,
+                "reloads": self.reloads,
+            }
+
+    def stats(self) -> Dict[str, Any]:
+        snap = self._batcher.stats()
+        snap["reloads"] = self.reloads
+        snap["models_released"] = self._handle.models_released
+        snap["load_seconds"] = round(self._load_seconds, 3)
+        with self._handle.lease() as (model, index):
+            snap["vocab_size"] = model.num_words
+            if index is not None:
+                snap["ann"] = dict(index.stats)
+        return snap
+
+    def status_snapshot(self) -> Dict[str, Any]:
+        snap = self.stats()
+        snap["status"] = "closed" if self._closed else "serving"
+        return snap
+
+    def emit_stats(self) -> None:
+        """Write one ``serve_stats`` telemetry record (periodic callers own
+        the cadence; the service never spawns a timer thread for it)."""
+        if self._sink is None:
+            return
+        s = self.stats()
+        self._sink.emit(
+            "serve_stats",
+            submitted=s["submitted"], refused=s["refused"],
+            batches=s["batches"], queue_depth=s["queue_depth"],
+            reloads=s["reloads"],
+            **{k: s[k] for k in ("latency_ms", "occupancy_mean", "ann")
+               if s.get(k) is not None})
+
+    def close(self) -> None:
+        """Drain the batcher, stop the watcher/statusd, release the model,
+        close the sink. Idempotent, and safe on a partially-initialized
+        service (the failed-__init__ cleanup path calls this)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._watcher is not None:
+            self._watcher.stop()
+        if self._batcher is not None:
+            self._batcher.stop()
+        if self._statusd is not None:
+            self._statusd.stop()
+        if self._sink is not None:
+            if self._batcher is not None:
+                s = self._batcher.stats()
+                self._sink.emit("serve_end", submitted=s["submitted"],
+                                refused=s["refused"], reloads=self.reloads)
+            self._sink.close()
+        if self._handle is not None:
+            if self._owns_model:
+                self._handle.stop()
+            else:
+                self._handle.detach()
+
+    def __enter__(self) -> "EmbeddingService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,460 @@
+"""Serving-tier tests (glint_word2vec_tpu/serve/, docs/serving.md):
+
+- the micro-batcher: coalescing, deadline flush, bounded-queue backpressure
+  (ServerOverloaded), per-request error isolation, drain-on-stop;
+- the IVF ANN index: deterministic build, full-probe == exact oracle,
+  recall@10 on clustered geometry, candidate-coverage expansion at tiny
+  cells, zero-norm padding exclusion;
+- the model's ANN entry (attach_ann + find_synonyms_batch(ann=True));
+- the lease-counted serving handle: in-flight batches finish on the old
+  model across a swap, buffers release exactly when leases drain;
+- the assembled EmbeddingService: exact arm parity with the model, hot
+  reload (explicit + watcher), schema-valid serve_* telemetry, and the
+  glint_serve_* Prometheus rendering.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from glint_word2vec_tpu.data.vocab import Vocabulary, build_vocab
+from glint_word2vec_tpu.models.word2vec import Word2VecModel
+from glint_word2vec_tpu.obs.schema import validate_file, validate_record
+from glint_word2vec_tpu.obs.statusd import serve_prometheus_text
+from glint_word2vec_tpu.serve import (
+    BatchingScheduler,
+    EmbeddingService,
+    ServerOverloaded,
+    ServingHandle,
+    build_ivf,
+)
+
+
+def clustered_matrix(v=3000, d=32, clusters=40, seed=0, noise=0.35):
+    """The serving bench's synthetic geometry: tight unit-centroid cells
+    (trained embeddings are clustered — the eval ladder measures topic
+    purity ~1.0 on healthy runs)."""
+    rng = np.random.default_rng(seed)
+    cents = rng.standard_normal((clusters, d)).astype(np.float32)
+    cents /= np.linalg.norm(cents, axis=1, keepdims=True)
+    return (cents[rng.integers(0, clusters, v)]
+            + noise * rng.standard_normal((v, d)).astype(np.float32)
+            / np.sqrt(d))
+
+
+def make_model(v=3000, d=32, seed=0):
+    m = clustered_matrix(v, d, seed=seed)
+    vocab = Vocabulary.from_words_and_counts(
+        [f"w{i}" for i in range(v)], np.ones(v, np.int64))
+    return Word2VecModel(vocab, jnp.asarray(m))
+
+
+# -- batcher ---------------------------------------------------------------------------
+
+
+def test_batcher_coalesces_concurrent_submits():
+    sizes = []
+
+    def handler(batch):
+        sizes.append(len(batch))
+        time.sleep(0.005)  # hold the worker so submitters pile up
+        return [x * 2 for x in batch]
+
+    b = BatchingScheduler(handler, max_batch=16, max_delay_ms=5.0,
+                          max_queue=128).start()
+    try:
+        results = {}
+
+        def client(i):
+            results[i] = b.submit(i)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(48)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {i: i * 2 for i in range(48)}
+        assert sum(sizes) == 48
+        assert max(sizes) > 1, f"no coalescing happened ({sizes})"
+        st = b.stats()
+        assert st["submitted"] == st["completed"] == 48
+        assert st["errors"] == st["refused"] == 0
+        assert st["batches"] == len(sizes)
+        assert st["latency_ms"]["n"] == 48
+    finally:
+        b.stop()
+
+
+def test_batcher_deadline_flushes_lone_request():
+    b = BatchingScheduler(lambda batch: [len(batch)], max_batch=1024,
+                          max_delay_ms=20.0, max_queue=8).start()
+    try:
+        t0 = time.monotonic()
+        assert b.submit("x") == 1  # a lone request must not wait forever
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        b.stop()
+
+
+def test_batcher_backpressure_refuses_fast():
+    gate = threading.Event()
+
+    def handler(batch):
+        gate.wait(30)
+        return batch
+
+    b = BatchingScheduler(handler, max_batch=1, max_delay_ms=0.0,
+                          max_queue=4).start()
+    try:
+        threads = []
+        # 1 in-flight inside the handler + 4 filling the queue
+        for i in range(5):
+            t = threading.Thread(target=lambda: b.submit(1))
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + 5
+        while b.stats()["queue_depth"] < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        with pytest.raises(ServerOverloaded):
+            b.submit(2)
+        assert time.monotonic() - t0 < 1.0, "refusal was not fast"
+        assert b.stats()["refused"] == 1
+        gate.set()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_batcher_per_request_errors_do_not_fail_the_batch():
+    def handler(batch):
+        return [ValueError(f"bad {x}") if x < 0 else x for x in batch]
+
+    b = BatchingScheduler(handler, max_batch=8, max_delay_ms=2.0,
+                          max_queue=32).start()
+    try:
+        assert b.submit(7) == 7
+        with pytest.raises(ValueError, match="bad -3"):
+            b.submit(-3)
+        assert b.submit(9) == 9
+        st = b.stats()
+        assert st["errors"] == 1 and st["completed"] == 2
+    finally:
+        b.stop()
+
+
+def test_batcher_handler_exception_reaches_every_caller():
+    def handler(batch):
+        raise RuntimeError("kaboom")
+
+    b = BatchingScheduler(handler, max_batch=4, max_delay_ms=1.0,
+                          max_queue=8).start()
+    try:
+        with pytest.raises(RuntimeError, match="kaboom"):
+            b.submit(1)
+    finally:
+        b.stop()
+    with pytest.raises(RuntimeError):
+        b.submit(2)  # stopped scheduler refuses new work
+
+
+# -- ANN index -------------------------------------------------------------------------
+
+
+def test_ivf_build_is_deterministic():
+    m = clustered_matrix()
+    a = build_ivf(m, seed=3, measure_recall=False)
+    b = build_ivf(m, seed=3, measure_recall=False)
+    np.testing.assert_array_equal(a._centroids, b._centroids)
+    np.testing.assert_array_equal(a._ids, b._ids)
+    c = build_ivf(m, seed=4, measure_recall=False)
+    assert not np.array_equal(a._centroids, c._centroids)
+
+
+def test_ivf_full_probe_matches_exact_oracle():
+    m = clustered_matrix(v=800, d=16)
+    idx = build_ivf(m, seed=0, measure_recall=False)
+    normed = m / np.maximum(
+        np.linalg.norm(m, axis=1, keepdims=True), 1e-12)
+    q = normed[:8]
+    s, ids = idx.search(q, 5, nprobe=idx.num_centroids)
+    exact = q @ normed.T
+    for r in range(8):
+        want = np.argsort(-exact[r], kind="stable")[:5]
+        assert set(ids[r]) == set(want), "full probe must equal exact scan"
+
+
+def test_ivf_recall_on_clustered_geometry():
+    idx = build_ivf(clustered_matrix(v=5000, d=32), seed=0)
+    assert idx.stats["recall_at_10"] >= 0.95  # the serving acceptance bar
+    # recall is monotone toward 1.0 as nprobe grows to C
+    probes = np.arange(64)
+    full = idx.measure_recall(probes, k=10, nprobe=idx.num_centroids)
+    assert full == 1.0
+
+
+def test_ivf_small_cells_still_fill_topk():
+    """The serve-reload chaos finding: at toy vocab the nprobe budget can
+    land on cells with fewer than k rows — probing must expand until the
+    candidate pool covers k, never return a short result."""
+    m = clustered_matrix(v=30, d=8, clusters=5)
+    idx = build_ivf(m, seed=0, measure_recall=False)
+    s, ids = idx.search(m[:4], 6, nprobe=1)
+    assert (ids >= 0).all(), f"short result at tiny cells: {ids}"
+
+
+def test_ivf_zero_norm_rows_never_surface():
+    m = clustered_matrix(v=200, d=16)
+    m[50] = 0.0  # a sharding-padding-style zero row
+    idx = build_ivf(m, seed=0, measure_recall=False)
+    _, ids = idx.search(m[:16], 10, nprobe=idx.num_centroids)
+    assert 50 not in set(ids.ravel().tolist())
+
+
+# -- model ANN entry -------------------------------------------------------------------
+
+
+def test_model_ann_routing_and_parity():
+    model = make_model()
+    with pytest.raises(RuntimeError, match="no index attached"):
+        model.find_synonyms_batch(["w0"], 5, ann=True)
+    index = build_ivf(np.asarray(model.syn0), seed=0)
+    model.attach_ann(index)
+    assert model.ann is index
+    exact = model.find_synonyms_batch(["w0", "w7"], 8)
+    ann_full = model.find_synonyms_batch(
+        ["w0", "w7"], 8, ann=True, nprobe=index.num_centroids)
+    # full probe: identical neighbors, identical self-exclusion semantics
+    assert [[w for w, _ in row] for row in ann_full] == \
+           [[w for w, _ in row] for row in exact]
+    for row_a, row_e in zip(ann_full, exact):
+        np.testing.assert_allclose([s for _, s in row_a],
+                                   [s for _, s in row_e], rtol=1e-5)
+    ann = model.find_synonyms_batch(["w0"], 10, ann=True)
+    assert len(ann[0]) == 10 and "w0" not in [w for w, _ in ann[0]]
+    model.stop()
+    assert model.ann is None
+
+
+# -- serving handle --------------------------------------------------------------------
+
+
+def test_handle_swap_drains_leases_before_release():
+    old, new = make_model(v=100, d=8, seed=1), make_model(v=100, d=8, seed=2)
+    h = ServingHandle(old)
+    with h.lease() as (m, _):
+        assert m is old
+        h.swap(new)
+        # the in-flight lease still serves the OLD model, un-released
+        assert m.num_words == 100 and not m._stopped
+        assert h.models_released == 0
+        with h.lease() as (m2, _):
+            assert m2 is new  # future leases see the new generation
+    # lease drained -> old released exactly once
+    assert h.models_released == 1 and old._stopped and not new._stopped
+    h.stop()
+    assert new._stopped and h.models_released == 2
+    with pytest.raises(RuntimeError):
+        with h.lease():
+            pass
+
+
+# -- the assembled service -------------------------------------------------------------
+
+
+def _train_tiny(tmp_path, seed=9, n=120):
+    from glint_word2vec_tpu.config import Word2VecConfig
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.train.trainer import Trainer
+    rng = np.random.default_rng(seed)
+    sents = [[f"w{j}" for j in rng.integers(0, 40, 12)] for _ in range(n)]
+    vocab = build_vocab(sents, min_count=1)
+    cfg = Word2VecConfig(vector_size=16, min_count=1, pairs_per_batch=128,
+                         num_iterations=1, window=2, negatives=3,
+                         negative_pool=8, steps_per_dispatch=2, seed=seed)
+    trainer = Trainer(cfg, vocab)
+    trainer.fit(encode_sentences(sents, vocab, cfg.max_sentence_length))
+    ck = str(tmp_path / "model")
+    trainer.save_checkpoint(ck)
+    return trainer, vocab, ck, sents
+
+
+def test_service_exact_arm_matches_model(tmp_path):
+    trainer, vocab, ck, _ = _train_tiny(tmp_path)
+    local = Word2VecModel.load(ck)
+    want = local.find_synonyms("w0", 5)
+    svc = EmbeddingService(checkpoint=ck, ann=False)
+    try:
+        got = svc.synonyms("w0", 5)
+        assert [w for w, _ in got] == [w for w, _ in want]
+        np.testing.assert_allclose([s for _, s in got],
+                                   [s for _, s in want], rtol=1e-5)
+        np.testing.assert_allclose(svc.vector("w1"), local.transform("w1"),
+                                   rtol=1e-6)
+        batch = svc.synonyms_batch(["w0", "w1", "w2"], 5)
+        assert len(batch) == 3 and all(len(r) == 5 for r in batch)
+        with pytest.raises(KeyError, match="not in vocabulary"):
+            svc.synonyms("nope", 5)
+        info = svc.info()
+        assert info["num_words"] == vocab.size and info["finished"]
+    finally:
+        svc.close()
+    local.stop()
+
+
+def test_service_reload_and_telemetry(tmp_path):
+    trainer, vocab, ck, sents = _train_tiny(tmp_path)
+    log = str(tmp_path / "serve.jsonl")
+    svc = EmbeddingService(checkpoint=ck, ann=True, telemetry_path=log)
+    try:
+        r1 = svc.synonyms("w0", 5)
+        assert len(r1) == 5
+        # the trainer publishes a newer checkpoint; explicit reload swaps
+        from glint_word2vec_tpu.data.pipeline import encode_sentences
+        trainer.fit(encode_sentences(sents, vocab, 1000))
+        trainer.save_checkpoint(ck)
+        model = svc.reload_now()
+        assert model.num_words == vocab.size
+        assert svc.stats()["reloads"] == 1
+        assert svc.stats()["models_released"] == 1  # old buffers gone
+        assert len(svc.synonyms("w0", 5)) == 5
+        svc.emit_stats()
+    finally:
+        svc.close()
+    summary = validate_file(log)
+    assert summary["ok"], summary["errors"][:3]
+    kinds = summary["kinds"]
+    assert kinds.get("serve_start") == 1
+    assert kinds.get("serve_reload") == 1
+    assert kinds.get("serve_stats") == 1
+    assert kinds.get("serve_end") == 1
+    with open(log) as f:
+        recs = [json.loads(line) for line in f]
+    start = next(r for r in recs if r["kind"] == "serve_start")
+    assert start["ann"]["centroids"] >= 1  # index stats ride the record
+
+
+def test_service_watcher_hot_reloads(tmp_path):
+    trainer, vocab, ck, sents = _train_tiny(tmp_path, seed=11)
+    svc = EmbeddingService(checkpoint=ck, ann=True, watch=True,
+                           reload_poll_s=0.05)
+    try:
+        from glint_word2vec_tpu.data.pipeline import encode_sentences
+        trainer.fit(encode_sentences(sents, vocab, 1000))
+        trainer.save_checkpoint(ck)  # the publish signal
+        deadline = time.monotonic() + 10
+        while svc.stats()["reloads"] < 1 and time.monotonic() < deadline:
+            assert len(svc.synonyms("w0", 5)) == 5  # serving never stops
+            time.sleep(0.02)
+        assert svc.stats()["reloads"] >= 1, "watcher never saw the publish"
+        assert svc.stats()["models_released"] >= 1
+    finally:
+        svc.close()
+
+
+def test_watcher_sees_publish_landing_during_boot_load(tmp_path, monkeypatch):
+    """Review finding: the publish signature must be captured BEFORE the
+    (slow) initial load + index build — a trainer publish landing inside
+    that window must still fire the watcher, not be recorded as served."""
+    trainer, vocab, ck, sents = _train_tiny(tmp_path, seed=13)
+    import glint_word2vec_tpu.serve.service as service_mod
+    real_load = service_mod.load_with_retry
+
+    def slow_load_with_publish(path, plan=None, **kw):
+        model = real_load(path, plan=plan, **kw)
+        # the trainer publishes AGAIN while the boot load is in flight
+        trainer.save_checkpoint(ck)
+        return model
+
+    monkeypatch.setattr(service_mod, "load_with_retry",
+                        slow_load_with_publish)
+    svc = EmbeddingService(checkpoint=ck, ann=False, watch=True,
+                           reload_poll_s=0.05)
+    monkeypatch.setattr(service_mod, "load_with_retry", real_load)
+    try:
+        deadline = time.monotonic() + 10
+        while svc.stats()["reloads"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.stats()["reloads"] >= 1, \
+            "publish during the boot load was swallowed"
+    finally:
+        svc.close()
+
+
+def test_failed_init_does_not_leak_threads_or_model():
+    """Review finding: a failed __init__ (here: status port already bound)
+    must stop the already-started batcher thread and leave a caller-owned
+    model untouched."""
+    import socket
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    model = make_model(v=100, d=8)
+    try:
+        with pytest.raises(OSError):
+            EmbeddingService(model=model, ann=False, status_port=port)
+        deadline = time.monotonic() + 5
+        while (any(t.name == "glint-serve-batcher"
+                   for t in threading.enumerate())
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert not any(t.name == "glint-serve-batcher"
+                       for t in threading.enumerate()), \
+            "batcher thread leaked past the failed init"
+        assert not model._stopped  # caller-owned model stays alive
+        # the pure-validation errors raise before ANY resource exists
+        with pytest.raises(ValueError, match="watch=True needs"):
+            EmbeddingService(model=model, watch=True)
+    finally:
+        blocker.close()
+        model.stop()
+
+
+def test_serve_record_kinds_validate():
+    base = {"schema": 1, "t": 0.0}
+    ok = [
+        {**base, "kind": "serve_start", "checkpoint": "/ck",
+         "vocab_size": 10, "vector_size": 4, "ann": {"centroids": 2}},
+        {**base, "kind": "serve_reload", "vocab_size": 10, "reloads": 1,
+         "load_seconds": 0.5},
+        {**base, "kind": "serve_stats", "submitted": 5, "refused": 0,
+         "batches": 2, "queue_depth": 0, "reloads": 1,
+         "latency_ms": {"p50": 1.0}, "occupancy_mean": 2.5},
+        {**base, "kind": "serve_end", "submitted": 5, "refused": 0,
+         "reloads": 1},
+    ]
+    for rec in ok:
+        assert validate_record(rec) == [], rec["kind"]
+    bad = {**base, "kind": "serve_stats", "submitted": 5}
+    assert validate_record(bad), "missing required fields must fail"
+    wrong = {**base, "kind": "serve_start", "checkpoint": "/ck",
+             "vocab_size": 10, "vector_size": 4, "ann": "not-a-dict"}
+    assert validate_record(wrong), "optional field with wrong type must fail"
+
+
+def test_serve_prometheus_rendering():
+    snap = {"status": "serving", "submitted": 12, "refused": 1,
+            "completed": 11, "errors": 0, "batches": 4, "queue_depth": 2,
+            "occupancy_mean": 3.0, "reloads": 2, "models_released": 2,
+            "vocab_size": 1000, "load_seconds": 0.4,
+            "latency_ms": {"p50": 1.5, "p95": 3.0, "p99": 4.5, "n": 11},
+            "ann": {"recall_at_10": 0.99, "nprobe": 8, "centroids": 64,
+                    "build_seconds": 0.2}}
+    text = serve_prometheus_text(snap)
+    for needle in ("glint_serve_up 1", "glint_serve_submitted_total 12",
+                   "glint_serve_refused_total 1",
+                   "glint_serve_queue_depth 2",
+                   'glint_serve_latency_ms{quantile="p99"} 4.5',
+                   "glint_serve_ann_recall_at_10 0.99",
+                   "glint_serve_reloads_total 2"):
+        assert needle in text, f"{needle!r} missing from:\n{text}"
+    assert "glint_serve_up 0" in serve_prometheus_text({"status": "closed"})

@@ -34,13 +34,19 @@ Every fault is deterministic (train/faults.py) — no sleep/kill-timing races:
    injected finite blowup under ``norm_watch="halt"`` must each leave a
    schema-valid ``<telemetry_path>.blackbox.json`` flight-recorder dump
    carrying ≥ 1 heartbeat and the terminal cause (signal / exception).
-7. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
+7. **serve-reload** — the serving tier under publish chaos (ISSUE 10,
+   docs/serving.md): a trainer thread publishes checkpoints every few steps
+   while a query storm runs against an EmbeddingService watching the same
+   path — zero failed/refused queries, ≥ 3 observed hot-reloads, and every
+   superseded model's buffers released once its in-flight leases drained.
+8. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
    exponential-backoff wrapper in ``data/`` must absorb them.
 
 Usage::
 
     python tools/chaos_run.py           # moderate sizes
     python tools/chaos_run.py --smoke   # small + fast (wired into tier-1 tests)
+    python tools/chaos_run.py --only serve-reload   # one phase (CI serving job)
 
 Exit code 0 iff every phase passed.
 """
@@ -366,6 +372,103 @@ def phase_blackbox(workdir: str, n_sentences: int) -> str:
     return ""
 
 
+def phase_serve_reload(workdir: str, n_sentences: int) -> str:
+    """Serving-tier chaos (ISSUE 10): the trainer publishes checkpoints
+    mid-query-storm. The service must (a) answer every query — no errors,
+    no refusals, no torn reads across the atomic swap; (b) observe >= 3
+    hot-reloads through the publish-signal watcher; (c) release every
+    superseded model's buffers once its in-flight leases drain."""
+    import threading
+    import time
+
+    from glint_word2vec_tpu.data.pipeline import encode_sentences
+    from glint_word2vec_tpu.data.vocab import build_vocab
+    from glint_word2vec_tpu.serve import EmbeddingService
+    from glint_word2vec_tpu.train.trainer import Trainer
+
+    sents = toy_sentences(n_sentences, seed=4)
+    vocab = build_vocab(sents, min_count=1)
+    cfg = toy_config()
+    enc = encode_sentences(sents, vocab, cfg.max_sentence_length)
+    trainer = Trainer(cfg, vocab)
+    ck = os.path.join(workdir, "ck")
+    trainer.save_checkpoint(ck)  # the service needs a first publish to boot
+
+    service = EmbeddingService(
+        checkpoint=ck, ann=True, watch=True, reload_poll_s=0.02,
+        max_batch=16, max_delay_ms=1.0)
+    fit_err, query_errs = [], []
+    queries = [0]
+
+    def fit():
+        try:
+            # checkpoint every 4 global steps: many publishes race the
+            # watcher's reloads and the storm below
+            trainer.fit(enc, checkpoint_path=ck, checkpoint_every_steps=4)
+            trainer.save_checkpoint(ck)
+        except Exception as e:  # noqa: BLE001 — re-raised via fit_err
+            fit_err.append(e)
+
+    t = threading.Thread(target=fit)
+    words = {f"w{i}" for i in range(30)}
+    storm_on = threading.Event()
+    storm_on.set()
+
+    def storm(ci: int):
+        i = 0
+        while storm_on.is_set() or i == 0:
+            i += 1
+            try:
+                res = service.synonyms(f"w{(ci * 7 + i) % 30}", 5)
+                if len(res) != 5 or not all(
+                        w in words and np.isfinite(s) for w, s in res):
+                    query_errs.append(f"bad result: {res}")
+            except Exception as e:  # noqa: BLE001 — ANY raise is the failure
+                query_errs.append(f"{type(e).__name__}: {e}")
+            queries[0] += 1
+
+    clients = [threading.Thread(target=storm, args=(c,)) for c in range(3)]
+    t.start()
+    for c in clients:
+        c.start()
+    t.join()
+    # the acceptance needs >= 3 OBSERVED publishes. Training publishes
+    # plenty, but on a loaded host a reload cycle (load + index build) can
+    # outlast the whole toy fit — so keep the storm up and keep PUBLISHING
+    # until the watcher has demonstrably observed three, bounded by a
+    # deadline (a watcher that never observes them is the failure)
+    deadline = time.monotonic() + 60
+    while service.stats()["reloads"] < 3 and time.monotonic() < deadline:
+        trainer.save_checkpoint(ck)
+        settle = time.monotonic() + 2
+        while (service.stats()["reloads"] < 3
+               and time.monotonic() < min(settle, deadline)):
+            time.sleep(0.05)
+    storm_on.clear()
+    for c in clients:
+        c.join()
+    try:
+        if fit_err:
+            return f"trainer died under the storm: {fit_err[0]}"
+        if query_errs:
+            return (f"{len(query_errs)} failed queries during publishes "
+                    f"(first: {query_errs[0]})")
+        stats = service.stats()
+        if stats["refused"]:
+            return f"{stats['refused']} queries refused (queue never fills here)"
+        if stats["reloads"] < 3:
+            return (f"only {stats['reloads']} hot-reloads observed across "
+                    f"the publish storm (need >= 3)")
+        if stats["models_released"] != stats["reloads"]:
+            return (f"buffer leak: {stats['reloads']} reloads but only "
+                    f"{stats['models_released']} old models released")
+        if queries[0] < 50:
+            return f"storm too thin ({queries[0]} queries) to prove overlap"
+    finally:
+        service.close()
+    return ""
+
+
 def phase_flaky_ingest(workdir: str) -> str:
     from glint_word2vec_tpu.data.corpus import encode_corpus
     from glint_word2vec_tpu.data.vocab import build_vocab
@@ -394,6 +497,9 @@ def main() -> int:
     ap.add_argument("--worker", choices=["crash", "blackbox"],
                     help="internal: run a fault-target worker leg")
     ap.add_argument("--sentences", type=int, default=0)
+    ap.add_argument("--only", default="",
+                    help="comma-separated phase names to run (default: all) "
+                         "— the CI serving job runs --only serve-reload")
     args = ap.parse_args()
 
     n_sentences = args.sentences or (300 if args.smoke else 1500)
@@ -417,12 +523,21 @@ def main() -> int:
         ("norm-recover", phase_norm_recover),
         ("blackbox",
          lambda: phase_blackbox(os.path.join(workdir, "p5"), n_sentences)),
+        ("serve-reload",
+         lambda: phase_serve_reload(os.path.join(workdir, "p6"), n_sentences)),
         ("flaky-ingest",
          lambda: phase_flaky_ingest(os.path.join(workdir, "p4"))),
     ]
+    if args.only:
+        want = {p.strip() for p in args.only.split(",") if p.strip()}
+        unknown = want - {name for name, _ in phases}
+        if unknown:
+            print(f"[chaos] unknown phase(s): {sorted(unknown)}", flush=True)
+            return 2
+        phases = [(name, fn) for name, fn in phases if name in want]
     failures = 0
     for name, fn in phases:
-        for sub in ("p1", "p2", "p4"):
+        for sub in ("p1", "p2", "p4", "p6"):
             os.makedirs(os.path.join(workdir, sub), exist_ok=True)
         err = fn()
         status = "PASS" if not err else f"FAIL: {err}"

@@ -329,12 +329,32 @@ def evaluate(words, emb: np.ndarray, index=None) -> dict:
     rnd = np.random.default_rng(1).standard_normal(
         emb.shape, dtype=np.float32)
     pur0, margin0 = purity(rnd)
+    # serving-index health (ISSUE 10 / docs/serving.md): build the IVF
+    # index exactly as checkpoint-publish does (serve/ann.py) and record
+    # its oracle-checked recall@10, so the quality ladder catches index
+    # degradation — a geometry that breaks IVF's clustering assumption
+    # (e.g. post-norm-blowup spread) shows up here before a deployment
+    # serves it. Best-effort: a failed build must not kill the quality row.
+    ann_channels = {}
+    try:
+        from glint_word2vec_tpu.serve.ann import build_ivf
+        t_ann = time.perf_counter()
+        ivf = build_ivf(emb, seed=0, recall_queries=256, recall_k=10)
+        ann_channels = {
+            "ann_recall_at_10": ivf.stats.get("recall_at_10"),
+            "ann_centroids": ivf.stats["centroids"],
+            "ann_nprobe": ivf.stats["nprobe"],
+            "ann_build_s": round(time.perf_counter() - t_ann, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — index health is additive
+        log(f"ann recall channel skipped: {type(e).__name__}: {e}")
     out = {
         "purity_at_10": round(pur, 4),
         "emb_abs_max": round(abs_max, 3),
         "rows_inf": rows_inf,
         "rows_abs_over_100": blown,
         **norm_channels,
+        **ann_channels,
         "purity_at_10_random_baseline": round(pur0, 4),
         "cosine_margin": round(margin, 4),
         "cosine_margin_random_baseline": round(margin0, 4),

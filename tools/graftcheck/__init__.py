@@ -3,7 +3,7 @@ checker over the ``Word2VecConfig`` knob lattice.
 
 Where graftlint R8 diffs the config/trainer refusal matrices as AST (what the
 source *promises*) and stepaudit checks the compiled artifact, graftcheck
-enumerates the 63-knob lattice from a declarative registry and actually RUNS
+enumerates the 69-knob lattice from a declarative registry and actually RUNS
 each candidate through the contracts the five historical serialization bugs
 violated (docs/static-analysis.md has the catalogue):
 
@@ -27,6 +27,6 @@ Violations shrink to minimal (≤3-knob) counterexamples; the expected refusal
 signatures live in the committed ``baseline.json`` with a drift gate in both
 directions. ``python -m tools.graftcheck`` prints exactly one JSON line on
 stdout (the R7 contract); ``--smoke`` is the tier-1 wiring, the full sweep
-(all 63 knobs pairwise + exhaustive refusal-relevant subsets, ≥1,000 executed
+(all 69 knobs pairwise + exhaustive refusal-relevant subsets, ≥1,000 executed
 configs) runs in CI.
 """

@@ -123,6 +123,17 @@ KNOBS = {k.name: k for k in [
               "serving thread); the statusd contract incl. zero-cost-when-"
               "off is tested in tests/test_statusd.py"),
     _K("blackbox_ring", (1, 256), invalid=0, dispatch_inert=True),
+    # --- serving-tier knobs (serve/, docs/serving.md): read only by the
+    # serving process (EmbeddingService), never by trainer construction or
+    # dispatch — dispatch-inert by construction ---
+    _K("serve_max_batch", (1, 16, 64), invalid=0, dispatch_inert=True),
+    _K("serve_max_delay_ms", (0.0, 2.0), invalid=-1.0, dispatch_inert=True),
+    _K("serve_queue_depth", (1, 256), invalid=0, dispatch_inert=True),
+    _K("serve_ann_centroids", (0, 8, 4096), invalid=-1, auto=0,
+       dispatch_inert=True),
+    _K("serve_ann_nprobe", (0, 1, 64), invalid=-1, auto=0,
+       dispatch_inert=True),
+    _K("serve_reload_poll_s", (0.05, 0.5), invalid=0.0, dispatch_inert=True),
 ]}
 
 

@@ -60,6 +60,14 @@ class R1ThreadPools:
         # training data, so the worker-count determinism contract R1 guards
         # is untouched (docs/observability.md)
         ("glint_word2vec_tpu/obs/statusd.py", "StatusServer.start"),
+        # the serving tier's two documented owners (docs/serving.md): the
+        # micro-batcher worker orders request/response PAIRING only (each
+        # caller gets exactly its own result; batch composition is
+        # timing-dependent by design), and the hot-reload watcher stats a
+        # file + invokes the swap callback — both READ-only on params, the
+        # training determinism contract untouched
+        ("glint_word2vec_tpu/serve/batcher.py", "BatchingScheduler.start"),
+        ("glint_word2vec_tpu/serve/reload.py", "CheckpointWatcher.start"),
     }
 
     def applies(self, path: str) -> bool:
@@ -430,7 +438,7 @@ class R7JsonStdout:
         "bench.py", "__graft_entry__.py", "tools/hostbench.py",
         "tools/collectives.py", "tools/shard_ab.py", "tools/stepaudit.py",
         "tools/telemetry_run.py", "tools/graftcheck/__main__.py",
-        "tools/run_report.py", "tools/perfgate.py",
+        "tools/run_report.py", "tools/perfgate.py", "tools/servebench.py",
     }
 
     def applies(self, path: str) -> bool:

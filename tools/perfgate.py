@@ -68,6 +68,23 @@ GATED: Dict[str, float] = {
     "cbow_examples_per_sec": 0.20,
 }
 
+# the SERVING trajectory's bands (--kind serve, SERVEBENCH_r*.json from
+# tools/servebench.py — ISSUE 10). All higher-is-better, same gate rule.
+# Thread-scheduling noise on closed/offered-loop latency arms is wider than
+# the step benches', hence the looser throughput bands; recall is a
+# deterministic property of (matrix, seed, nprobe), so its band is tight —
+# a recall drop means the index or its auto rules changed, not weather.
+SERVE_GATED: Dict[str, float] = {
+    # closed-loop ANN capacity (qps) through the full service path
+    "ann_qps": 0.30,
+    # the acceptance headline: exact per-query p50 / ANN operating-point p50
+    "ann_speedup_p50": 0.35,
+    # oracle-checked index recall at the auto operating point
+    "ann_recall_at_10": 0.03,
+    # highest offered load with < 1% refusals
+    "offered_qps_sustained": 0.30,
+}
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -103,22 +120,29 @@ def gate(new: dict, rungs: List[dict],
     metrics = {}
     ok = True
     for name, band in bands.items():
+        # None-valued metrics are treated as absent: servebench emits null
+        # for legitimately unmeasurable values (recall below 11 rows, p50 of
+        # an empty offered row) — the gate must FAIL on them with a report,
+        # not crash on float(None) past the R7 one-JSON-line contract
         history = [(r["path"], float(r["parsed"][name]))
-                   for r in rungs if name in r["parsed"]]
+                   for r in rungs
+                   if r["parsed"].get(name) is not None]
         if not history:
             continue
         ref_path, ref = history[-1]           # the latest rung: the claim
         best_path, best = max(history, key=lambda kv: kv[1])
         floor = (1.0 - band) * ref
         entry = {"ref": ref, "ref_rung": ref_path, "band": band,
-                 "floor": round(floor, 1),
+                 # 4 decimals: serving gates fractional metrics (recall)
+                 # where 1-decimal display rounded the floor to 1.0
+                 "floor": round(floor, 4),
                  # advisory: how far the current claim itself sits below the
                  # all-time best (non-monotonic trajectory drift)
                  "best": best, "best_rung": best_path,
                  "drift_from_best": round(1.0 - ref / best, 4)}
-        if name not in new:
+        if new.get(name) is None:
             metrics[name] = {**entry, "new": None, "ok": False,
-                             "why": "metric missing from the fresh line"}
+                             "why": "metric missing/null in the fresh line"}
             ok = False
             continue
         val = float(new[name])
@@ -133,11 +157,15 @@ def gate(new: dict, rungs: List[dict],
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--bench", default="",
-                    help="fresh bench.py JSON (raw line or driver capture) "
-                         "to gate against the trajectory")
-    ap.add_argument("--baselines", default=os.path.join(_REPO,
-                                                        "BENCH_r*.json"),
-                    help="glob of committed trajectory rungs")
+                    help="fresh bench.py/servebench.py JSON (raw line or "
+                         "driver capture) to gate against the trajectory")
+    ap.add_argument("--kind", choices=["train", "serve"], default="train",
+                    help="which trajectory/bands: 'train' = bench.py vs "
+                         "BENCH_r*.json (GATED), 'serve' = servebench.py vs "
+                         "SERVEBENCH_r*.json (SERVE_GATED)")
+    ap.add_argument("--baselines", default="",
+                    help="glob of committed trajectory rungs (default "
+                         "derives from --kind)")
     ap.add_argument("--smoke", action="store_true",
                     help="machine-independent self-test: the genuine latest "
                          "rung must pass, a seeded regression must fire")
@@ -154,18 +182,27 @@ def main() -> int:
 def _run(args) -> tuple:
     """All modes funnel through here so main() keeps exactly one
     ``print(json.dumps(...))`` (the R7 stdout contract)."""
+    bands = SERVE_GATED if args.kind == "serve" else GATED
+    if not args.baselines:
+        args.baselines = os.path.join(
+            _REPO, "SERVEBENCH_r*.json" if args.kind == "serve"
+            else "BENCH_r*.json")
     rungs = load_trajectory(args.baselines)
-    if len(rungs) < 2:
+    # the serving trajectory legitimately starts at one rung (r01 is the
+    # subsystem's birth); the training trajectory predates the gate and
+    # must never regress to a single readable rung
+    min_rungs = 1 if args.kind == "serve" else 2
+    if len(rungs) < min_rungs:
         return {"ok": False,
-                "error": f"need >= 2 baseline rungs at {args.baselines}, "
-                         f"found {len(rungs)}"}, 2
+                "error": f"need >= {min_rungs} baseline rungs at "
+                         f"{args.baselines}, found {len(rungs)}"}, 2
 
     if args.smoke:
         genuine = rungs[-1]["parsed"]
-        g = gate(genuine, rungs)
+        g = gate(genuine, rungs, bands)
         seeded = {k: float(genuine[k]) * args.seed_factor
-                  for k in GATED if k in genuine}
-        s = gate(seeded, rungs)
+                  for k in bands if genuine.get(k) is not None}
+        s = gate(seeded, rungs, bands)
         fired_on = sorted(k for k, m in s["metrics"].items()
                           if not m["ok"])
         result = {
@@ -173,6 +210,7 @@ def _run(args) -> tuple:
             # AND the seeded regression trips it
             "ok": bool(g["ok"] and not s["ok"]),
             "mode": "smoke",
+            "kind": args.kind,
             "genuine": {"rung": rungs[-1]["path"], "ok": g["ok"],
                         "metrics": g["metrics"]},
             "seeded": {"factor": args.seed_factor, "ok": s["ok"],
@@ -192,8 +230,9 @@ def _run(args) -> tuple:
     except (OSError, json.JSONDecodeError) as e:
         return {"ok": False,
                 "error": f"unreadable --bench {args.bench}: {e}"}, 2
-    result = gate(new, rungs)
+    result = gate(new, rungs, bands)
     result["mode"] = "gate"
+    result["kind"] = args.kind
     result["bench"] = args.bench
     for name, m in result["metrics"].items():
         log(f"perfgate {name}: new {m['new']} vs ref {m['ref']} "

@@ -8,6 +8,11 @@ checkpoints — any number of serving processes can load the same checkpoint dir
 copy) and answer transform/find_synonyms while training continues writing newer
 checkpoints alongside.
 
+This CLI is a THIN CLIENT of the serving subsystem (glint_word2vec_tpu/serve/,
+docs/serving.md): the swap-window retry logic lives in serve/reload.py (the single
+owner), queries ride the request batcher, and ``--ann`` serves the IVF index arm
+built at load time. The JSON-lines request/response contract below is unchanged.
+
 Protocol: JSON-lines over stdin/stdout — one request object per line, one response
 object per line (the process-boundary analog of the reference's Akka query RPCs, with
 the same ops the PS served: pull / multiply+top-k, mllib:514,598):
@@ -18,9 +23,11 @@ the same ops the PS served: pull / multiply+top-k, mllib:514,598):
     {"op": "vector", "word": "berlin"}
     {"op": "reload"}                      # pick up a newer checkpoint at the same path
     {"op": "info"}
+    {"op": "stats"}                       # serving-tier gauges (batcher/ANN/reloads)
 
 Usage:
     python tools/serve_checkpoint.py /path/to/checkpoint [--mesh DATAxMODEL]
+        [--ann] [--nprobe N] [--watch] [--status-port P] [--telemetry PATH]
 """
 
 import argparse
@@ -42,90 +49,87 @@ def main():
     ap.add_argument("--mesh", default=None,
                     help="DATAxMODEL, e.g. 1x8: load row-shards straight onto this "
                          "mesh (no dense host copy)")
+    ap.add_argument("--ann", action="store_true",
+                    help="serve synonym queries from the IVF ANN index (built at "
+                         "load/reload time; exact remains the oracle default)")
+    ap.add_argument("--nprobe", type=int, default=0,
+                    help="ANN cells probed per query (0 = the config/auto value)")
+    ap.add_argument("--watch", action="store_true",
+                    help="hot-reload automatically on the trainer's checkpoint "
+                         "publish signal (the explicit reload op still works)")
+    ap.add_argument("--status-port", type=int, default=0,
+                    help="> 0: serve glint_serve_* gauges on 127.0.0.1:<port> "
+                         "(/status.json, /metrics, /healthz)")
+    ap.add_argument("--telemetry", default="",
+                    help="non-empty: write serve_* telemetry records to this "
+                         "JSONL path (obs/sink.py)")
     args = ap.parse_args()
 
-    from glint_word2vec_tpu.models.word2vec import Word2VecModel
     from glint_word2vec_tpu.parallel.mesh import make_mesh
+    from glint_word2vec_tpu.serve import EmbeddingService
 
     plan = None
     if args.mesh:
         d, m = (int(x) for x in args.mesh.lower().split("x"))
         plan = make_mesh(d, m)
 
-    def load_with_retry(attempts=8, delay=0.25):
-        """The trainer's atomic swap has a sub-second window where the checkpoint
-        path is mid-rename / the old dir is being removed; a reload landing inside
-        it sees FileNotFoundError or a half-listed directory. Retry over the window
-        instead of bouncing the error to the client."""
-        import time
-        for i in range(attempts):
-            try:
-                return Word2VecModel.load(args.checkpoint, plan=plan)
-            # only the transient swap-window failures: a missing path, half-written
-            # JSON, or a metadata/words pair read across the two renames of the
-            # swap (surfaces as the loader's vocab_size-mismatch ValueError).
-            # Permanent problems (bad --mesh for the shard layout, corrupt arrays)
-            # surface immediately instead of retrying.
-            except (FileNotFoundError, json.JSONDecodeError) as e:
-                last = e
-            except ValueError as e:
-                if "vocab_size" not in str(e) and "words" not in str(e):
-                    raise
-                last = e
-            if i == attempts - 1:
-                raise last
-            time.sleep(delay)
-
-    model = load_with_retry()
+    service = EmbeddingService(
+        checkpoint=args.checkpoint, plan=plan, ann=args.ann,
+        nprobe=args.nprobe or None, watch=args.watch,
+        telemetry_path=args.telemetry, status_port=args.status_port)
 
     def out(obj):
         sys.stdout.write(json.dumps(obj) + "\n")
         sys.stdout.flush()
 
-    out({"ready": True, "num_words": model.num_words,
-         "vector_size": model.vector_size})
-    for line in sys.stdin:
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            req = json.loads(line)
-            op = req["op"]
-            if op == "synonyms":
-                res = model.find_synonyms(req["word"], int(req.get("num", 10)))
-                out({"synonyms": [[w, s] for w, s in res]})
-            elif op == "synonyms_vec":
-                import numpy as np
-                vec = np.asarray(req["vector"], np.float32)
-                res = model.find_synonyms(vec, int(req.get("num", 10)))
-                out({"synonyms": [[w, s] for w, s in res]})
-            elif op == "synonyms_batch":
-                # many queries, one device dispatch per chunk — through a thin
-                # link per-query round trips dominate (PERF.md §6)
-                res = model.find_synonyms_batch(
-                    list(req["words"]), int(req.get("num", 10)))
-                out({"synonyms": [[[w, s] for w, s in row] for row in res]})
-            elif op == "vector":
-                out({"vector": model.transform(req["word"]).tolist()})
-            elif op == "reload":
-                old = model
-                model = load_with_retry()
-                old.stop()
-                out({"reloaded": True, "num_words": model.num_words})
-            elif op == "info":
-                out({"num_words": model.num_words,
-                     "vector_size": model.vector_size,
-                     "iteration": (model.train_state.iteration
-                                   if model.train_state else None),
-                     "finished": (model.train_state.finished
-                                  if model.train_state else None)})
-            elif op == "quit":
-                out({"bye": True})
-                break
-            else:
-                out({"error": f"unknown op {op!r}"})
-        except Exception as e:  # noqa: BLE001 — protocol errors go to the client
-            out({"error": f"{type(e).__name__}: {e}"})
+    info = service.info()
+    out({"ready": True, "num_words": info["num_words"],
+         "vector_size": info["vector_size"]})
+    try:
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                op = req["op"]
+                if op == "synonyms":
+                    res = service.synonyms(req["word"], int(req.get("num", 10)))
+                    out({"synonyms": [[w, s] for w, s in res]})
+                elif op == "synonyms_vec":
+                    import numpy as np
+                    vec = np.asarray(req["vector"], np.float32)
+                    res = service.synonyms(vec, int(req.get("num", 10)))
+                    out({"synonyms": [[w, s] for w, s in res]})
+                elif op == "synonyms_batch":
+                    # many queries, one device dispatch per coalesced batch —
+                    # through a thin link per-query round trips dominate
+                    # (PERF.md §6); the batcher owns the coalescing now
+                    res = service.synonyms_batch(
+                        list(req["words"]), int(req.get("num", 10)))
+                    out({"synonyms": [[[w, s] for w, s in row] for row in res]})
+                elif op == "vector":
+                    out({"vector": service.vector(req["word"]).tolist()})
+                elif op == "reload":
+                    model = service.reload_now()
+                    out({"reloaded": True, "num_words": model.num_words})
+                elif op == "info":
+                    i = service.info()
+                    out({"num_words": i["num_words"],
+                         "vector_size": i["vector_size"],
+                         "iteration": i["iteration"],
+                         "finished": i["finished"]})
+                elif op == "stats":
+                    out(service.stats())
+                elif op == "quit":
+                    out({"bye": True})
+                    break
+                else:
+                    out({"error": f"unknown op {op!r}"})
+            except Exception as e:  # noqa: BLE001 — protocol errors go to the client
+                out({"error": f"{type(e).__name__}: {e}"})
+    finally:
+        service.close()
 
 
 if __name__ == "__main__":
