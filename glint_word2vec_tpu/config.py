@@ -551,6 +551,44 @@ class Word2VecConfig:
                                     # the checkpoint publish signal
                                     # (metadata.json identity; serve/reload.py)
 
+    # --- continual training (docs/continual.md; continual/ — read by the
+    # continual DRIVER (ContinualRunner / tools/continual_run.py), never by
+    # trainer construction or dispatch: dispatch-inert by construction, like
+    # the serve_* tier. The knobs travel with the checkpoint so a
+    # deployment's increment policy is pinned beside the model it grows.) ---
+    continual_min_new_words: int = 1  # vocab-extension trigger: grow
+                                    # syn0/syn1 only when at least this many
+                                    # NEW words pass min_count in the corpus
+                                    # tail; below it the increment trains
+                                    # under the existing vocabulary (counts
+                                    # still merge, alias table still rebuilt)
+    continual_lr_rewarm: float = 1.0  # learning-rate re-warm per increment:
+                                    # each incremental fit starts at
+                                    # learning_rate * this and decays over
+                                    # the increment's own word clock (the
+                                    # reference decays alpha over ONE corpus
+                                    # pass; a continual deployment needs the
+                                    # clock re-armed per increment). Applied
+                                    # through the trainer's dispatch-time lr
+                                    # scale (the recovery ladder's staging
+                                    # point), NEVER by rewriting
+                                    # learning_rate — the config persists
+                                    # into every publish, and a rewritten lr
+                                    # would compound to rewarm^k after k
+                                    # increments
+    continual_iterations: int = 1   # epochs per incremental fit over the
+                                    # new corpus tail (+ replay segments)
+    continual_replay_segments: int = 0  # how many of the most recent
+                                    # already-consumed segments to re-train
+                                    # alongside each new tail — the
+                                    # forgetting mitigation (the
+                                    # eval_quality --continual-ab gate
+                                    # measures what 0 costs); replayed
+                                    # segments reuse their cached encodes
+    continual_poll_s: float = 2.0   # driver poll cadence over the
+                                    # append-only corpus directory between
+                                    # increments (continual/loop.py)
+
     def __post_init__(self) -> None:
         if self.embedding_partition not in ("rows", "cols"):
             raise ValueError(
@@ -944,6 +982,29 @@ class Word2VecConfig:
             raise ValueError(
                 f"serve_reload_poll_s must be positive "
                 f"but got {self.serve_reload_poll_s}")
+        if self.continual_min_new_words <= 0:
+            # 0 would make every increment a (pointless) zero-growth
+            # extension pass; "never grow" is not a policy this knob
+            # expresses (drop the driver instead)
+            raise ValueError(
+                f"continual_min_new_words must be positive "
+                f"but got {self.continual_min_new_words}")
+        if self.continual_lr_rewarm <= 0:
+            raise ValueError(
+                f"continual_lr_rewarm must be positive "
+                f"but got {self.continual_lr_rewarm}")
+        if self.continual_iterations <= 0:
+            raise ValueError(
+                f"continual_iterations must be positive "
+                f"but got {self.continual_iterations}")
+        if self.continual_replay_segments < 0:
+            raise ValueError(
+                f"continual_replay_segments must be nonnegative "
+                f"but got {self.continual_replay_segments}")
+        if self.continual_poll_s <= 0:
+            raise ValueError(
+                f"continual_poll_s must be positive "
+                f"but got {self.continual_poll_s}")
 
     def replace(self, **kwargs) -> "Word2VecConfig":
         if (getattr(self, "_auto_pool", False)

@@ -1,0 +1,51 @@
+"""Continual training subsystem (docs/continual.md, ROADMAP item 5).
+
+Turns one-shot fits into a continuous train→publish→serve loop:
+
+- :mod:`.extend` — incremental vocabulary extension on a checkpoint
+  (identity-prefix growth, seeded new rows, per-shard for row-shards, the
+  ``vocab_lineage`` fingerprint chain);
+- :mod:`.stream` — the append-only corpus: fingerprinted segments, a
+  persisted consumed-offset cursor, a delta encode pass that reuses cached
+  encodes of old segments;
+- :mod:`.loop` — :class:`~glint_word2vec_tpu.continual.loop.ContinualRunner`,
+  the watch→extend→fit→publish driver whose atomic publishes feed the
+  serving tier's ``CheckpointWatcher`` (docs/serving.md).
+
+CLI: ``tools/continual_run.py`` (R7 one-JSON-line contract; ``--smoke`` runs
+the self-contained end-to-end drill).
+"""
+
+from glint_word2vec_tpu.continual.extend import (
+    VocabDelta,
+    compute_vocab_delta,
+    extend_checkpoint,
+    extended_vocabulary,
+    grow_arrays,
+    lineage_fingerprints,
+    seed_new_rows,
+)
+from glint_word2vec_tpu.continual.loop import ContinualRunner
+from glint_word2vec_tpu.continual.stream import (
+    ConcatCorpus,
+    CorpusStream,
+    StreamCursor,
+    encode_delta,
+    segment_fingerprint,
+)
+
+__all__ = [
+    "VocabDelta",
+    "compute_vocab_delta",
+    "extended_vocabulary",
+    "extend_checkpoint",
+    "grow_arrays",
+    "seed_new_rows",
+    "lineage_fingerprints",
+    "ContinualRunner",
+    "ConcatCorpus",
+    "CorpusStream",
+    "StreamCursor",
+    "encode_delta",
+    "segment_fingerprint",
+]

@@ -1,0 +1,484 @@
+"""Incremental vocabulary extension — grow a checkpoint onto a drifted corpus.
+
+The reference retrains from scratch whenever the vocabulary changes (its runs
+are all-or-nothing, SURVEY §5); ``estimator.resume`` refuses a fingerprint
+mismatch outright. This module turns that dead end into a *migration*: given a
+checkpoint and the word counts of a corpus tail, it
+
+1. computes the **vocab delta** — new words past ``min_count``, merged counts
+   for surviving words (:func:`compute_vocab_delta`);
+2. builds the **extended vocabulary** with the *identity-prefix* contract
+   (:func:`extended_vocabulary`): surviving words keep their EXACT indices
+   (rows are never re-sorted by the merged counts — a re-sort would permute
+   every embedding row and invalidate every cached encode), new words append
+   after them in descending tail-count order. The old→new index remap is
+   therefore the identity on ``[0, V_old)`` — recorded explicitly in the
+   lineage so readers never have to infer it;
+3. grows ``syn0``/``syn1`` by the new rows (:func:`grow_arrays`) — surviving
+   rows carried over **bit-identically** (verified against the parent's
+   recorded digests / re-read bytes), new ``syn0`` rows seeded with the
+   classic word2vec init U(−0.5/D, 0.5/D) from a deterministic
+   ``(seed, V_old, V_new)``-keyed stream, new ``syn1`` rows zero (σ=0.5
+   starting gradient, exactly like a fresh fit's rows);
+4. records the migration in a **fingerprint lineage chain**
+   (``metadata.json["vocab_lineage"]``): one entry per extension with the
+   parent and child :func:`~glint_word2vec_tpu.data.corpus.vocab_fingerprint`,
+   sizes, and the remap kind — ``resume()`` consults the chain to accept
+   encode caches written under ANY ancestor vocabulary (their ids are still
+   valid under the identity-prefix contract).
+
+Both checkpoint layouts are supported. The **row-shards** path grows
+per-shard without ever densifying ``[V, D]`` on one host: shards fully below
+``V_old`` are carried verbatim (hash-verified during the copy, parent digest
+reused), the boundary shard is sliced at ``V_old`` (padding rows drop), pure
+padding shards drop, and one fresh shard ``rows-<V_old>-<V_new>`` carries the
+seeded new rows. Peak memory is one shard, not one matrix.
+
+The negative-sampling alias table is NOT stored in checkpoints — the Trainer
+rebuilds it from ``vocab.counts`` at construction, so the merged-counts
+rebuild happens for free on the next increment. A rebuild is
+distribution-exact for the merged counts (tested; see ops/sampler.py), but
+the *realized* negative-sample stream differs from the pre-extension one —
+the same cross-release caveat as the round-8 vectorized builder (PERF.md
+§10): continual increments may legally change the negative stream.
+
+Host-side, single-process by design: extension is a migration step between
+fits, not a collective — a multi-host deployment runs it once on the
+coordinator and lets every process stream the grown checkpoint back in
+through ``load_params_into_plan``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from glint_word2vec_tpu.data.corpus import vocab_fingerprint
+from glint_word2vec_tpu.data.vocab import Vocabulary, count_words
+from glint_word2vec_tpu.train.checkpoint import (
+    CheckpointCorruptError,
+    _HashingWriter,
+    _format_version,
+    _merge_extra_metadata,
+    _save_npy_hashed,
+    _save_words_hashed,
+    _sha256_file,
+    SHARDED_FORMAT_VERSION,
+    ShardedMatrixReader,
+    TrainState,
+    load_model,
+    load_model_header,
+)
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+#: The only remap kind this writer emits: surviving words keep their indices,
+#: new words append. Readers that meet an unknown kind must refuse, not guess.
+REMAP_IDENTITY_PREFIX = "identity-prefix"
+
+
+@dataclasses.dataclass
+class VocabDelta:
+    """The difference between a checkpoint's vocabulary and a corpus tail."""
+
+    new_words: List[str]        # promoted words, descending tail count
+    new_counts: np.ndarray      # int64 [len(new_words)] — tail counts
+    merged_counts: np.ndarray   # int64 [V_old] — old counts + tail counts
+    tail_words_total: int       # total tail occurrences seen (incl. dropped)
+
+    @property
+    def num_new(self) -> int:
+        return len(self.new_words)
+
+
+def compute_vocab_delta(
+    vocab: Vocabulary,
+    tail_counts: Mapping[str, int],
+    min_count: int,
+) -> VocabDelta:
+    """Split a tail's word counts into merged-survivor counts and promoted
+    new words.
+
+    Promotion uses the TAIL count alone: the checkpoint only persists counts
+    for words that made the vocabulary, so a word's sub-``min_count``
+    occurrences from earlier eras are gone (O(V) state, the streaming trade —
+    the reference re-counts the whole corpus instead; docs/continual.md). New
+    words sort by descending tail count, ties on first-seen order (the same
+    stable tie-break as :meth:`Vocabulary.from_counter`).
+    """
+    merged = vocab.counts.copy()
+    fresh: List[tuple] = []
+    total = 0
+    for w, c in tail_counts.items():
+        total += int(c)
+        i = vocab.get(w)
+        if i >= 0:
+            merged[i] += int(c)
+        elif c >= min_count:
+            fresh.append((w, int(c)))
+    fresh.sort(key=lambda wc: -wc[1])
+    return VocabDelta(
+        new_words=[w for w, _ in fresh],
+        new_counts=np.asarray([c for _, c in fresh], dtype=np.int64),
+        merged_counts=merged,
+        tail_words_total=total,
+    )
+
+
+def extended_vocabulary(vocab: Vocabulary, delta: VocabDelta) -> Vocabulary:
+    """The identity-prefix extension: old words at their old indices (merged
+    counts), new words appended. NOTE the descending-count global invariant
+    of fresh vocabularies is deliberately given up — preserving row identity
+    is what keeps carried rows, cached encodes, and the serving tier's ids
+    valid across increments."""
+    if not delta.num_new:
+        return Vocabulary.from_words_and_counts(
+            vocab.words, delta.merged_counts)
+    return Vocabulary.from_words_and_counts(
+        list(vocab.words) + list(delta.new_words),
+        np.concatenate([delta.merged_counts, delta.new_counts]))
+
+
+def seed_new_rows(
+    n_new: int,
+    vector_size: int,
+    seed: int,
+    old_vocab_size: int,
+    dtype=np.float32,
+) -> np.ndarray:
+    """Deterministic init for the grown ``syn0`` rows: the classic word2vec
+    U(−0.5/D, 0.5/D), keyed by ``(seed, V_old, n_new)`` so the same extension
+    on the same checkpoint reproduces bit-identically — and a LATER extension
+    (different V_old) draws a fresh stream."""
+    rng = np.random.default_rng(
+        [int(seed) & 0xFFFFFFFF, int(old_vocab_size), int(n_new)])
+    lim = 0.5 / float(vector_size)
+    return rng.uniform(-lim, lim, size=(n_new, vector_size)).astype(dtype)
+
+
+def lineage_entry(old_vocab: Vocabulary, new_vocab: Vocabulary,
+                  delta: VocabDelta,
+                  tail_fingerprint: Optional[str] = None) -> Dict[str, Any]:
+    """One vocab_lineage chain link for this migration.
+
+    ``tail_fingerprint`` identifies WHICH corpus tail this migration merged
+    (the driver passes a digest of the tail segments' content fingerprints):
+    a retry of a crashed increment compares it against the chain's last link
+    to recognize an already-applied merge instead of double-weighting the
+    tail's counts."""
+    entry = {
+        "parent_fingerprint": vocab_fingerprint(old_vocab),
+        "fingerprint": vocab_fingerprint(new_vocab),
+        "old_vocab_size": old_vocab.size,
+        "new_vocab_size": new_vocab.size,
+        "new_words": delta.num_new,
+        "remap": REMAP_IDENTITY_PREFIX,
+    }
+    if tail_fingerprint is not None:
+        entry["tail_fingerprint"] = tail_fingerprint
+    return entry
+
+
+def lineage_fingerprints(lineage: Sequence[Mapping[str, Any]]) -> List[str]:
+    """Every ancestor fingerprint a lineage chain names (parents + children;
+    the terminal child equals the checkpoint's own current fingerprint).
+    Encode caches written under ANY of these are valid under the current
+    vocabulary — identity-prefix remaps never move an id."""
+    out: List[str] = []
+    for entry in lineage:
+        if entry.get("remap") != REMAP_IDENTITY_PREFIX:
+            # an unknown remap kind could have moved ids; nothing before it
+            # in the chain is safe to reuse
+            out.clear()
+            continue
+        for key in ("parent_fingerprint", "fingerprint"):
+            fp = entry.get(key)
+            if isinstance(fp, str) and fp not in out:
+                out.append(fp)
+    return out
+
+
+def grow_arrays(
+    syn0: np.ndarray,
+    syn1: Optional[np.ndarray],
+    delta: VocabDelta,
+    vector_size: int,
+    seed: int,
+) -> tuple:
+    """Dense growth: carried rows are the SAME bytes (``np.concatenate``
+    copies but never transforms; verified by the caller against the parent),
+    new ``syn0`` rows seeded, new ``syn1`` rows zero."""
+    n = delta.num_new
+    if n == 0:
+        return syn0, syn1
+    V_old = syn0.shape[0]
+    cols = syn0.shape[1]
+    new0 = np.zeros((n, cols), dtype=syn0.dtype)
+    new0[:, :vector_size] = seed_new_rows(
+        n, vector_size, seed, V_old, dtype=syn0.dtype)
+    g0 = np.concatenate([np.asarray(syn0), new0])
+    g1 = None
+    if syn1 is not None:
+        g1 = np.concatenate(
+            [np.asarray(syn1), np.zeros((n, cols), dtype=syn1.dtype)])
+    return g0, g1
+
+
+def extend_checkpoint(
+    checkpoint_path: str,
+    tail: "Iterable[Sequence[str]] | Mapping[str, int]",
+    out_path: Optional[str] = None,
+    min_count: Optional[int] = None,
+    min_new_words: int = 1,
+    tail_fingerprint: Optional[str] = None,
+    verify: bool = True,
+) -> Dict[str, Any]:
+    """Migrate a checkpoint onto a drifted corpus: grow the vocabulary and
+    the embedding matrices, merge counts, append the lineage link.
+
+    ``tail`` is either a word→count mapping (the driver's counted corpus
+    tail) or an iterable of token sequences (counted here). ``out_path``
+    defaults to IN-PLACE migration — the write is the trainer's atomic
+    tmp+rename swap, so it doubles as a publish the serving watcher picks up
+    (new words become servable with their seeded vectors immediately;
+    the incremental fit then improves them). ``min_count`` defaults to the
+    checkpoint config's.
+
+    ``verify=True`` re-reads the carried region of the written checkpoint and
+    asserts it is bit-identical to the source rows (dense), or hash-verifies
+    every carried shard against the parent's recorded digests during the copy
+    (row-shards — the verification IS the copy pass there, no extra read).
+
+    Returns a report dict: sizes, new-word count, the appended lineage entry,
+    and the output path. ``min_new_words`` (the ``continual_min_new_words``
+    knob) gates GROWTH: below it the promoted words are dropped for this
+    migration. Zero-growth migrations still merge counts, still append a
+    lineage link (``new_words: 0`` — the fingerprint changes with the merged
+    counts, and the chain is what keeps old encode caches acceptable), and
+    still publish — frequencies drifted, so the next increment's alias table
+    must see the merged counts.
+    """
+    header = load_model_header(checkpoint_path)
+    cfg = header["config"]
+    if min_count is None:
+        min_count = cfg.min_count
+    old_vocab = Vocabulary.from_words_and_counts(
+        header["words"], header["counts"])
+    if isinstance(tail, Mapping):
+        counts = tail
+    else:
+        counts = count_words(tail)
+    prior = list(header.get("vocab_lineage") or [])
+    if (tail_fingerprint is not None and prior
+            and prior[-1].get("tail_fingerprint") == tail_fingerprint):
+        # this exact tail was already merged by a crashed previous attempt
+        # (the increment died between its extension publish and its cursor
+        # save) — re-applying would double-weight the tail's counts
+        logger.info("extension for tail %s already applied to %s; skipping "
+                    "the re-merge", tail_fingerprint, checkpoint_path)
+        return {
+            "old_vocab_size": prior[-1]["old_vocab_size"],
+            "new_vocab_size": prior[-1]["new_vocab_size"],
+            "new_words": prior[-1]["new_words"],
+            "tail_words_total": 0,
+            "lineage_entry": prior[-1],
+            "lineage_depth": len(prior),
+            "path": out_path or checkpoint_path,
+            "layout": header["layout"],
+            "already_applied": True,
+        }
+    delta = compute_vocab_delta(old_vocab, counts, min_count)
+    if delta.num_new < max(min_new_words, 1):
+        delta = VocabDelta(
+            new_words=[], new_counts=np.zeros(0, dtype=np.int64),
+            merged_counts=delta.merged_counts,
+            tail_words_total=delta.tail_words_total)
+    new_vocab = extended_vocabulary(old_vocab, delta)
+    entry = lineage_entry(old_vocab, new_vocab, delta, tail_fingerprint)
+    chain = prior + [entry]
+    dst = out_path or checkpoint_path
+    state: TrainState = header["train_state"]
+    if header["layout"] == "row-shards":
+        _extend_row_shards(checkpoint_path, dst, header, new_vocab, delta,
+                           chain, state, verify=verify)
+    else:
+        _extend_dense(checkpoint_path, dst, header, new_vocab, delta,
+                      chain, state, verify=verify)
+    logger.info(
+        "extended checkpoint %s: vocab %d -> %d (+%d new words, "
+        "%d tail occurrences) -> %s", checkpoint_path, old_vocab.size,
+        new_vocab.size, delta.num_new, delta.tail_words_total, dst)
+    return {
+        "old_vocab_size": old_vocab.size,
+        "new_vocab_size": new_vocab.size,
+        "new_words": delta.num_new,
+        "tail_words_total": delta.tail_words_total,
+        "lineage_entry": entry,
+        "lineage_depth": len(chain),
+        "path": dst,
+        "layout": header["layout"],
+    }
+
+
+def _extend_dense(src: str, dst: str, header: Dict[str, Any],
+                  new_vocab: Vocabulary, delta: VocabDelta,
+                  chain: List[dict], state: TrainState,
+                  verify: bool) -> None:
+    from glint_word2vec_tpu.train.checkpoint import save_model
+
+    data = load_model(src, header=header, verify=False)
+    syn0, syn1 = grow_arrays(
+        data["syn0"], data["syn1"], delta,
+        header["vector_size"] or data["syn0"].shape[1],
+        header["config"].seed)
+    save_model(dst, new_vocab.words, new_vocab.counts,
+               syn0, syn1, header["config"], state,
+               extra_metadata={"vocab_lineage": chain})
+    if verify:
+        V_old = delta.merged_counts.shape[0]
+        # the writer stores float32 (save_model converts); compare in the
+        # written dtype so the check is byte-for-byte what a reader gets —
+        # BOTH matrices: syn1 is the training state the next increment
+        # resumes from, a silently-corrupted carry there would train every
+        # subsequent increment against wrong context vectors
+        for name, src_arr in (("syn0", data["syn0"]), ("syn1", data["syn1"])):
+            if src_arr is None:
+                continue
+            carried = np.load(os.path.join(dst, f"{name}.npy"),
+                              mmap_mode="r")[:V_old]
+            if not np.array_equal(np.asarray(carried),
+                                  np.asarray(src_arr, dtype=np.float32)):
+                raise CheckpointCorruptError(
+                    f"extended checkpoint {dst!r}: carried {name} rows are "
+                    f"not bit-identical to the source — migration bug or "
+                    f"torn write")
+
+
+def _copy_shard_verified(src_file: str, dst_file: str,
+                         want_digest: Optional[str]) -> str:
+    """Copy one shard file, hashing in the same pass; verify against the
+    parent's recorded digest when one exists. Returns the digest (reused in
+    the child's digest map — the bytes are identical by construction)."""
+    with open(src_file, "rb") as fin, open(dst_file, "wb") as fout:
+        w = _HashingWriter(fout)
+        shutil.copyfileobj(fin, w, length=1 << 20)
+    got = w.sha.hexdigest()
+    if want_digest is not None and got != want_digest:
+        raise CheckpointCorruptError(
+            f"shard {src_file!r} digest {got[:12]}… does not match the "
+            f"parent checkpoint's recorded {want_digest[:12]}… — refusing "
+            f"to carry a corrupt shard into the extended checkpoint")
+    return got
+
+
+def _extend_row_shards(src: str, dst: str, header: Dict[str, Any],
+                       new_vocab: Vocabulary, delta: VocabDelta,
+                       chain: List[dict], state: TrainState,
+                       verify: bool) -> None:
+    """Per-shard growth: never materializes [V, D]; peak memory is one
+    shard. Carried shards below V_old copy verbatim (digest-verified in the
+    copy pass), the boundary shard slices at V_old, padding-only shards
+    drop, and one new shard carries the seeded rows [V_old, V_new)."""
+    with open(os.path.join(src, "metadata.json"), encoding="utf-8") as f:
+        src_meta = json.load(f)
+    parent_digests: Dict[str, str] = src_meta.get("digests") or {}
+    cfg = header["config"]
+    V_old = delta.merged_counts.shape[0]
+    V_new = new_vocab.size
+    vector_size = header["vector_size"] or cfg.vector_size
+
+    parent = os.path.dirname(os.path.abspath(dst)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent, f".{os.path.basename(dst)}.tmp-{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        digests: Dict[str, str] = {}
+        padded_dim = None
+        for name in ("syn0", "syn1"):
+            src_dir = os.path.join(src, f"{name}.shards")
+            if not os.path.isdir(src_dir):
+                continue
+            reader = ShardedMatrixReader(src_dir)
+            padded_dim = reader.cols
+            dst_dir = os.path.join(tmp, f"{name}.shards")
+            os.makedirs(dst_dir)
+            for start, stop, fname in reader._spans:
+                rel_src = f"{name}.shards/{fname}"
+                if stop <= V_old:
+                    # wholly real rows: verbatim carry, digest-verified
+                    digests[rel_src] = _copy_shard_verified(
+                        os.path.join(src_dir, fname),
+                        os.path.join(dst_dir, fname),
+                        parent_digests.get(rel_src) if verify else None)
+                elif start < V_old:
+                    # the boundary shard: slice the padding rows off so the
+                    # new rows can take coordinates [V_old, V_new)
+                    if verify and rel_src in parent_digests:
+                        got = _sha256_file(os.path.join(src_dir, fname))
+                        if got != parent_digests[rel_src]:
+                            raise CheckpointCorruptError(
+                                f"shard {rel_src!r} digest mismatch in "
+                                f"{src!r} — refusing to slice a corrupt "
+                                f"boundary shard")
+                    m = reader._undo_void(np.load(
+                        os.path.join(src_dir, fname), mmap_mode="r"))
+                    out_name = f"rows-{start:010d}-{V_old:010d}.npy"
+                    digests[f"{name}.shards/{out_name}"] = _save_npy_hashed(
+                        os.path.join(dst_dir, out_name),
+                        np.ascontiguousarray(m[:V_old - start]))
+                # start >= V_old: pure padding shard, dropped
+            if delta.num_new:
+                if name == "syn0":
+                    block = np.zeros((delta.num_new, reader.cols),
+                                     dtype=reader.dtype)
+                    block[:, :vector_size] = seed_new_rows(
+                        delta.num_new, vector_size, cfg.seed, V_old,
+                        dtype=reader.dtype)
+                else:
+                    block = np.zeros((delta.num_new, reader.cols),
+                                     dtype=reader.dtype)
+                out_name = f"rows-{V_old:010d}-{V_new:010d}.npy"
+                digests[f"{name}.shards/{out_name}"] = _save_npy_hashed(
+                    os.path.join(dst_dir, out_name), block)
+        digests["words"] = _save_words_hashed(
+            os.path.join(tmp, "words"), new_vocab.words)
+        digests["counts.npy"] = _save_npy_hashed(
+            os.path.join(tmp, "counts.npy"),
+            np.asarray(new_vocab.counts, dtype=np.int64))
+        meta = {
+            "format_version": _format_version(SHARDED_FORMAT_VERSION, state),
+            "framework": "glint_word2vec_tpu",
+            "layout": "row-shards",
+            "vocab_size": V_new,
+            "vector_size": int(vector_size),
+            # spans now end exactly at V_new: the grown checkpoint carries no
+            # padding rows (loaders re-pad onto their own target mesh)
+            "padded_vocab": V_new,
+            "padded_dim": int(padded_dim if padded_dim is not None
+                              else vector_size),
+            "config": cfg.to_dict(auto_markers=False),
+            "train_state": state.to_dict(),
+            "digests": digests,
+        }
+        _merge_extra_metadata(meta, {"vocab_lineage": chain})
+        with open(os.path.join(tmp, "metadata.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(meta, f, indent=2)
+        old = None
+        if os.path.exists(dst):
+            old = dst + f".old-{os.getpid()}"
+            os.rename(dst, old)
+        os.rename(tmp, dst)
+        if old is not None:
+            shutil.rmtree(old)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise

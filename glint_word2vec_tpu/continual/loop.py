@@ -1,0 +1,379 @@
+"""The continual driver: watch corpus → extend vocab → incremental fit →
+atomic publish — the loop that turns one-shot fits into a system that never
+stops (ROADMAP item 5; docs/continual.md).
+
+One :class:`ContinualRunner` owns a (checkpoint path, corpus stream, work
+dir) triple. Each :meth:`run_once` cycle:
+
+1. polls the append-only corpus stream for unconsumed segments
+   (continual/stream.py) — nothing new → idle, no work;
+2. counts the tail's words and computes the vocab delta against the
+   checkpoint's vocabulary; at ``continual_min_new_words`` or more promoted
+   words, migrates the checkpoint through
+   :func:`~glint_word2vec_tpu.continual.extend.extend_checkpoint` — an
+   ATOMIC in-place publish, so a watching ``EmbeddingService`` hot-reloads
+   the grown model (new words servable with seeded vectors) before the
+   incremental fit even starts; below the threshold, counts still merge
+   (frequencies drifted — the next alias table must see them);
+3. delta-encodes only the new tail under the (possibly grown) vocabulary —
+   cached encodes of consumed segments stay valid through the lineage chain
+   and are reused untouched, optionally replayed
+   (``continual_replay_segments``);
+4. runs the incremental fit: the checkpoint's params stream back in, the
+   learning rate re-warms to ``learning_rate * continual_lr_rewarm`` and
+   decays over the increment's own word clock, the PRNG lattice continues
+   from the checkpoint's ``global_step`` (no negative-sample replay), and
+   every save — periodic and final — carries the lineage chain and lands
+   through the same atomic-swap publish signal PR 10's serving tier polls;
+5. marks the tail consumed ONLY after the fit finished, so a SIGTERM
+   mid-increment leaves a resumable published checkpoint and an unconsumed
+   cursor — the next cycle simply retries the increment from the last
+   published params (the extension re-run is a no-op: zero new words).
+
+The runner is deliberately thread-free (one blocking loop, graftlint R1 has
+nothing to sanction): run it as its own process
+(``tools/continual_run.py``) beside the serving replicas, exactly the
+trainer/server process split the deployment story already assumes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from glint_word2vec_tpu.config import Word2VecConfig
+from glint_word2vec_tpu.continual import extend as _extend
+from glint_word2vec_tpu.continual.stream import (
+    ConcatCorpus,
+    CorpusStream,
+    StreamCursor,
+    encode_delta,
+    encode_segment,
+    segment_fingerprint,
+)
+from glint_word2vec_tpu.data.corpus import vocab_fingerprint
+from glint_word2vec_tpu.data.vocab import (
+    Vocabulary,
+    count_words,
+    merge_counts,
+)
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+
+class ContinualRunner:
+    """Drives continual train→publish cycles over an append-only corpus.
+
+    ``checkpoint_path`` is the publish path serving replicas watch;
+    ``corpus_dir`` the append-only segment directory; ``work_dir`` holds the
+    cursor and the per-segment encode caches. ``config_overrides`` replace
+    checkpoint-config fields for every increment (e.g. a different
+    ``continual_lr_rewarm``); ``plan`` routes row-shards checkpoints
+    straight onto a mesh. ``telemetry_path`` opens a runner-owned sink for
+    the additive ``continual_*`` record kinds (obs/schema.py).
+    """
+
+    def __init__(
+        self,
+        checkpoint_path: str,
+        corpus_dir: str,
+        work_dir: str,
+        plan=None,
+        config_overrides: Optional[Dict[str, Any]] = None,
+        checkpoint_every_steps: Optional[int] = None,
+        telemetry_path: str = "",
+    ):
+        self.checkpoint_path = checkpoint_path
+        self.stream = CorpusStream(corpus_dir)
+        self.work_dir = work_dir
+        self.plan = plan
+        self.config_overrides = dict(config_overrides or {})
+        self.checkpoint_every_steps = checkpoint_every_steps
+        self.increments = 0
+        self._sink = None
+        if telemetry_path:
+            from glint_word2vec_tpu.obs.sink import TelemetrySink
+            self._sink = TelemetrySink(telemetry_path)
+        os.makedirs(work_dir, exist_ok=True)
+        self.cursor = StreamCursor(work_dir)
+
+    # -- helpers -----------------------------------------------------------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self._sink is not None:
+            self._sink.emit(kind, **fields)
+
+    def _cache_dir(self) -> str:
+        return os.path.join(self.work_dir, "encode-cache")
+
+    def _recovered_checkpoint(self) -> str:
+        """The publish path, healed if the last save died mid-swap: the
+        atomic protocol guarantees either the old or the new checkpoint
+        verifies; torn-swap debris is reclaimed (the writer — us — is not
+        running concurrently with this call by construction)."""
+        from glint_word2vec_tpu.train.checkpoint import (
+            load_latest_valid, verify_checkpoint)
+        try:
+            verify_checkpoint(self.checkpoint_path)
+            return self.checkpoint_path
+        except (FileNotFoundError, ValueError):
+            recovered = load_latest_valid(
+                os.path.dirname(os.path.abspath(self.checkpoint_path))
+                or ".", reclaim=True)
+            if recovered != self.checkpoint_path:
+                logger.warning("recovered checkpoint at %s (expected %s)",
+                               recovered, self.checkpoint_path)
+            return recovered
+
+    def _load_config(self, header: Dict[str, Any]) -> Word2VecConfig:
+        cfg: Word2VecConfig = header["config"]
+        if self.config_overrides:
+            cfg = cfg.replace(**self.config_overrides)
+        return cfg
+
+    def _load_params(self, path: str, header: Dict[str, Any], cfg):
+        """Checkpoint params as an EmbeddingPair ready for the Trainer —
+        streamed onto the mesh for row-shards + plan (never a full host
+        copy), host-loaded otherwise. Mirrors estimator.resume's split."""
+        from glint_word2vec_tpu.ops.sgns import EmbeddingPair
+        from glint_word2vec_tpu.train.checkpoint import (
+            load_model, load_params_into_plan)
+        if self.plan is not None and header["layout"] == "row-shards":
+            from glint_word2vec_tpu.parallel.mesh import (
+                pad_dim_to_lanes, pad_vocab_for_sharding)
+            pv = pad_vocab_for_sharding(header["vocab_size"],
+                                        self.plan.num_model)
+            pd = pad_dim_to_lanes(cfg.vector_size, cfg.pad_vector_to_lanes)
+            syn0, syn1 = load_params_into_plan(
+                path, self.plan, pv, pd, dtype=np.dtype(cfg.param_dtype),
+                io_workers=cfg.io_workers)
+            if syn1 is None:
+                raise ValueError(
+                    "checkpoint has no syn1; cannot train an increment")
+            return EmbeddingPair(syn0, syn1)
+        data = load_model(path, header=header, io_workers=cfg.io_workers)
+        if data["syn1"] is None:
+            raise ValueError(
+                "checkpoint has no syn1; cannot train an increment")
+        return EmbeddingPair(data["syn0"], data["syn1"])
+
+    # -- bootstrap ---------------------------------------------------------------------
+
+    def ensure_base(self) -> Dict[str, Any]:
+        """First-run bootstrap: when no checkpoint exists yet, fit a base
+        model over every segment currently in the stream and publish it.
+        Idempotent — with an existing checkpoint this is a no-op."""
+        if os.path.exists(
+                os.path.join(self.checkpoint_path, "metadata.json")):
+            return {"action": "none"}
+        from glint_word2vec_tpu.train.trainer import Trainer
+        names = self.cursor.new_segments(self.stream)
+        if not names:
+            raise FileNotFoundError(
+                f"no checkpoint at {self.checkpoint_path!r} and no corpus "
+                f"segments under {self.stream.directory!r} to bootstrap "
+                f"from")
+        cfg = Word2VecConfig(**self.config_overrides)
+        counter = merge_counts(
+            count_words(self.stream.corpus(n)) for n in names)
+        vocab = Vocabulary.from_counter(counter, cfg.min_count)
+        parts = [encode_segment(self.stream, n, vocab, self._cache_dir(),
+                                cfg.max_sentence_length) for n in names]
+        t0 = time.perf_counter()
+        trainer = Trainer(cfg, vocab, plan=self.plan)
+        trainer.fit(ConcatCorpus(parts),
+                    checkpoint_path=self.checkpoint_path,
+                    checkpoint_every_steps=self.checkpoint_every_steps)
+        vfp = vocab_fingerprint(vocab)
+        for name, enc in zip(names, parts):
+            self.cursor.mark_consumed(
+                name, segment_fingerprint(self.stream.path(name)),
+                vfp, enc.meta)
+        self.cursor.save()
+        report = {"action": "base", "segments": len(names),
+                  "vocab_size": vocab.size,
+                  "train_seconds": round(time.perf_counter() - t0, 3)}
+        self._emit("continual_increment", increment=0,
+                   segments=len(names), vocab_size=vocab.size,
+                   new_words=vocab.size, words=int(vocab.train_words_count),
+                   train_seconds=report["train_seconds"])
+        return report
+
+    # -- one cycle ---------------------------------------------------------------------
+
+    def run_once(self) -> Dict[str, Any]:
+        """One poll→extend→fit→publish cycle; returns a report dict
+        (``action`` = "idle" | "increment")."""
+        new_names = self.cursor.new_segments(self.stream)
+        if not new_names:
+            return {"action": "idle", "segments": 0}
+        ck = self._recovered_checkpoint()
+        from glint_word2vec_tpu.train.checkpoint import (
+            TrainState, load_model_header)
+        header = load_model_header(ck)
+        cfg = self._load_config(header)
+
+        # 1. count the tail (pass 1 of the two-pass streaming contract) —
+        # only segments whose counts have NOT already been merged: a crashed
+        # increment retries the fit without double-weighting the tail
+        # (cursor.counted, the stage marker saved right after the extension
+        # publish below)
+        count_names = self.cursor.uncounted(new_names)
+        grew = False
+        report = {"new_words": 0}
+        if count_names:
+            tail_counts = merge_counts(
+                count_words(self.stream.corpus(n)) for n in count_names)
+
+            # 2. migrate — EVERY increment with fresh counts: growth when
+            # >= continual_min_new_words promoted words, a counts-merge
+            # otherwise (either way the vocab fingerprint changes with the
+            # merged counts, so the lineage link the migration appends is
+            # what keeps old encode caches — and resume()'s cache
+            # acceptance — valid). This write is atomic publish #1: a
+            # watching EmbeddingService hot-reloads the grown model before
+            # the incremental fit even starts. The tail_fingerprint rides
+            # the lineage link so a retry whose previous attempt died
+            # BETWEEN this publish and the cursor save below recognizes the
+            # already-applied merge instead of double-weighting the tail.
+            tail_fp = "+".join(
+                f"{n}={segment_fingerprint(self.stream.path(n))}"
+                for n in count_names)
+            report = _extend.extend_checkpoint(
+                ck, tail_counts, out_path=self.checkpoint_path,
+                min_count=cfg.min_count,
+                min_new_words=cfg.continual_min_new_words,
+                tail_fingerprint=tail_fp)
+            ck = report["path"]
+            grew = report["new_words"] > 0
+            header = load_model_header(ck)
+            cfg = self._load_config(header)
+            for name in count_names:
+                self.cursor.mark_counted(
+                    name, segment_fingerprint(self.stream.path(name)))
+            self.cursor.save()
+            if grew:
+                self._emit("continual_extend",
+                           old_vocab_size=report["old_vocab_size"],
+                           new_vocab_size=report["new_vocab_size"],
+                           new_words=report["new_words"])
+        vocab = Vocabulary.from_words_and_counts(
+            header["words"], header["counts"])
+
+        lineage = list(header.get("vocab_lineage") or [])
+        allowed = _extend.lineage_fingerprints(lineage)
+
+        # 3. delta encode: only the tail is new work
+        enc = encode_delta(
+            self.stream, self.cursor, vocab, self._cache_dir(),
+            max_sentence_length=cfg.max_sentence_length,
+            lineage=allowed,
+            replay_segments=cfg.continual_replay_segments)
+
+        # 4. incremental fit — lr re-warmed, PRNG lattice continued. The
+        # re-warm rides the trainer's dispatch-time lr scale (the same
+        # staging point the recovery ladder backs lr off through), NOT a
+        # config.learning_rate rewrite: the Trainer persists its config
+        # into every publish, and a rewritten lr would COMPOUND — after k
+        # increments at rewarm 0.8 the deployment's base lr would silently
+        # read as 0.8^k of itself. The published checkpoint keeps the base
+        # learning_rate; only the increment's dispatched alphas scale.
+        from glint_word2vec_tpu.train.trainer import Trainer
+        params = self._load_params(ck, header, cfg)
+        inc_cfg = cfg.replace(num_iterations=cfg.continual_iterations)
+        state = TrainState(global_step=header["train_state"].global_step)
+        t0 = time.perf_counter()
+        trainer = Trainer(inc_cfg, vocab, plan=self.plan, params=params,
+                          train_state=state)
+        if cfg.continual_lr_rewarm != 1.0:
+            trainer._lr_scale = cfg.continual_lr_rewarm
+        trainer.extra_checkpoint_meta = {"vocab_lineage": lineage}
+        # corpus_words: the lr-decay clock must anneal over the INCREMENT's
+        # corpus, not the full merged history the vocab counts imply
+        trainer.fit(enc["corpus"], checkpoint_path=self.checkpoint_path,
+                    checkpoint_every_steps=self.checkpoint_every_steps,
+                    corpus_words=enc["corpus"].total_tokens)
+        train_seconds = round(time.perf_counter() - t0, 3)
+
+        # 5. consume the tail — only now, so a crash above retries cleanly
+        vfp = vocab_fingerprint(vocab)
+        for name in enc["new"]:
+            self.cursor.mark_consumed(
+                name, segment_fingerprint(self.stream.path(name)),
+                vfp, enc["encoded"][name].meta)
+        self.cursor.save()
+        self.increments += 1
+        words = sum(int(enc["encoded"][n].total_tokens) for n in enc["new"])
+        self._emit("continual_increment", increment=self.increments,
+                   segments=len(enc["new"]), vocab_size=vocab.size,
+                   new_words=report["new_words"], words=words,
+                   train_seconds=train_seconds)
+        return {
+            "action": "increment",
+            "increment": self.increments,
+            "segments": len(enc["new"]),
+            "replayed": len(enc["replayed"]),
+            "grew": grew,
+            "new_words": report["new_words"],
+            "vocab_size": vocab.size,
+            "words": words,
+            "lineage_depth": len(lineage),
+            "train_seconds": train_seconds,
+        }
+
+    # -- the loop ----------------------------------------------------------------------
+
+    def run_forever(
+        self,
+        max_increments: Optional[int] = None,
+        max_idle_polls: Optional[int] = None,
+        poll_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Poll→increment until a bound trips: ``max_increments`` completed
+        increments, or ``max_idle_polls`` CONSECUTIVE empty polls (both None
+        = run until killed — SIGTERM lands between or inside increments and
+        either way leaves a resumable checkpoint + consistent cursor).
+        ``poll_s`` defaults to the config knob of the current checkpoint (or
+        the dataclass default before a checkpoint exists)."""
+        if poll_s is None:
+            # the knobs travel with the checkpoint: a deployment that
+            # pinned continual_poll_s there must be honored; overrides win,
+            # the dataclass default is the pre-checkpoint fallback
+            try:
+                from glint_word2vec_tpu.train.checkpoint import (
+                    load_model_header)
+                poll_s = self._load_config(
+                    load_model_header(self.checkpoint_path)).continual_poll_s
+            except (FileNotFoundError, ValueError):
+                poll_s = Word2VecConfig(
+                    **self.config_overrides).continual_poll_s
+        done, idle = 0, 0
+        while True:
+            report = self.run_once()
+            if report["action"] == "increment":
+                done += 1
+                idle = 0
+                logger.info("continual increment %d: %s",
+                            report["increment"], report)
+                if max_increments is not None and done >= max_increments:
+                    return {"increments": done, "stopped": "max_increments"}
+            else:
+                idle += 1
+                if max_idle_polls is not None and idle >= max_idle_polls:
+                    return {"increments": done, "stopped": "idle"}
+                time.sleep(poll_s)
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+    def __enter__(self) -> "ContinualRunner":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,288 @@
+"""Streaming corpus — an append-only segment directory with a persisted
+consumed-offset cursor and a delta encode pass.
+
+The production corpus never stops growing: new token files land in a
+directory (``seg-000.txt``, ``seg-001.txt``, …), each IMMUTABLE once written
+(the append-only contract — a segment whose bytes change under the cursor is
+an error, not a refresh). The continual driver (continual/loop.py) consumes
+the directory incrementally:
+
+- :class:`CorpusStream` — lists the segments in sorted-name order and
+  fingerprints their content (size + head/tail CRC, cheap at any size).
+- :class:`StreamCursor` — the persisted consumed-offset: which segments have
+  been trained through, each with the content fingerprint it had and the
+  vocabulary fingerprint it was encoded under. Written atomically
+  (tmp + ``os.replace``) so a SIGTERM between increments never tears it.
+- :func:`encode_delta` — encodes ONLY the new tail under the current
+  (possibly just-extended) vocabulary; already-consumed segments reuse their
+  cached encode as-is when their recorded vocab fingerprint is the current
+  one OR any ancestor in the checkpoint's lineage chain — the
+  identity-prefix extension contract (continual/extend.py) keeps ancestor
+  ids valid, so the common continual case re-encodes nothing old.
+- :class:`ConcatCorpus` — a zero-copy ``Sequence`` view over several
+  :class:`~glint_word2vec_tpu.data.corpus.EncodedCorpus` segments, so the
+  trainer consumes (replay + tail) as one corpus without concatenating
+  files.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from glint_word2vec_tpu.data.corpus import (
+    EncodedCorpus,
+    TokenFileCorpus,
+    encode_corpus,
+    vocab_fingerprint,
+)
+from glint_word2vec_tpu.data.vocab import Vocabulary
+
+logger = logging.getLogger("glint_word2vec_tpu")
+
+_CURSOR = "cursor.json"
+_FP_BYTES = 1 << 20  # head/tail window hashed per segment
+
+
+def segment_fingerprint(path: str) -> str:
+    """Cheap content identity of one segment file: size plus CRC32 of the
+    first and last MiB — enough to catch truncation, in-place edits, and
+    the classic rewrite-with-same-name violation of the append-only
+    contract, without re-reading multi-GB segments every poll."""
+    size = os.path.getsize(path)
+    h = 0
+    with open(path, "rb") as f:
+        h = zlib.crc32(f.read(_FP_BYTES), h)
+        if size > _FP_BYTES:
+            f.seek(max(size - _FP_BYTES, 0))
+            h = zlib.crc32(f.read(_FP_BYTES), h)
+    return f"{size}-{h:08x}"
+
+
+class CorpusStream:
+    """The append-only corpus: a directory of immutable token segment files
+    (one sentence per line, whitespace-tokenized — the TokenFileCorpus
+    format), consumed in sorted-name order."""
+
+    def __init__(self, directory: str, suffix: str = ".txt"):
+        self.directory = directory
+        self.suffix = suffix
+
+    def segments(self) -> List[str]:
+        """Sorted segment file names currently present."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError as e:
+            raise FileNotFoundError(
+                f"cannot list corpus stream directory "
+                f"{self.directory!r}: {e}") from e
+        return sorted(n for n in names
+                      if n.endswith(self.suffix)
+                      and not n.startswith("."))
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    def corpus(self, name: str) -> TokenFileCorpus:
+        return TokenFileCorpus(self.path(name))
+
+
+class StreamCursor:
+    """Persisted consumed-offset over a :class:`CorpusStream`.
+
+    ``consumed`` maps segment name → record::
+
+        {"fingerprint": <content fp at consume time>,
+         "vocab_fingerprint": <vocab fp the cached encode was written under>,
+         "n_sentences": int, "total_tokens": int}
+
+    Saves are atomic (tmp + ``os.replace``); a crash between increments
+    leaves either the old or the new cursor, never a torn one — and because
+    the driver marks segments consumed only AFTER a successful increment,
+    re-running after a crash retries the whole increment (idempotent: the
+    extension is a no-op the second time, the fit re-trains the same tail
+    from the last published params).
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.consumed: Dict[str, Dict[str, Any]] = {}
+        # the count-merge stage marker: segments whose word counts have
+        # already been merged into the checkpoint (the extension publish)
+        # but whose increment has NOT finished training. A crashed increment
+        # retries the FIT without re-merging the counts. The remaining
+        # window — a crash BETWEEN the extension publish and this marker's
+        # save — is closed by the lineage link's tail_fingerprint
+        # (extend.py): the retry recognizes the already-applied merge. The
+        # two together make the increment exactly idempotent (chaos phase
+        # continual-drift + tests drive both windows).
+        self.counted: Dict[str, Dict[str, Any]] = {}
+        # per-process audit memo: consumed segments whose (size, mtime_ns)
+        # matched when their content fingerprint last verified. Re-CRCing
+        # every consumed segment on EVERY poll is O(total history) in disk
+        # reads — a year-old deployment would re-read GBs per idle poll; a
+        # stat compare catches the same in-place-edit violations for free,
+        # and any stat change re-verifies the content.
+        self._audit_memo: Dict[str, tuple] = {}
+        os.makedirs(directory, exist_ok=True)
+        p = os.path.join(directory, _CURSOR)
+        if os.path.exists(p):
+            with open(p, encoding="utf-8") as f:
+                doc = json.load(f)
+            self.consumed = doc.get("consumed", {})
+            self.counted = doc.get("counted", {})
+
+    def save(self) -> None:
+        p = os.path.join(self.directory, _CURSOR)
+        tmp = p + f".tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"consumed": self.consumed,
+                       "counted": self.counted}, f, indent=1)
+        os.replace(tmp, p)
+
+    def new_segments(self, stream: CorpusStream) -> List[str]:
+        """Names present in the stream but not yet consumed, sorted. Also
+        audits the append-only contract on the CONSUMED set: a consumed
+        segment that vanished or changed content is an error — silently
+        training on a mutated history would corrupt the count/lineage
+        bookkeeping."""
+        names = stream.segments()
+        present = set(names)
+        for name, rec in self.consumed.items():
+            if name not in present:
+                raise ValueError(
+                    f"consumed segment {name!r} vanished from "
+                    f"{stream.directory!r} — the corpus stream is "
+                    f"append-only; restore the segment or rebuild the "
+                    f"cursor")
+            st = os.stat(stream.path(name))
+            sig = (st.st_size, st.st_mtime_ns)
+            if self._audit_memo.get(name) == sig:
+                continue  # verified under this exact stat already
+            fp = segment_fingerprint(stream.path(name))
+            if fp != rec.get("fingerprint"):
+                raise ValueError(
+                    f"consumed segment {name!r} changed content "
+                    f"({rec.get('fingerprint')} -> {fp}) — the corpus "
+                    f"stream is append-only; write drift as a NEW segment")
+            self._audit_memo[name] = sig
+        return [n for n in names if n not in self.consumed]
+
+    def uncounted(self, names: Iterable[str]) -> List[str]:
+        """The subset of ``names`` whose counts have not been merged yet."""
+        return [n for n in names if n not in self.counted]
+
+    def mark_counted(self, name: str, fingerprint: str) -> None:
+        self.counted[name] = {"fingerprint": fingerprint}
+
+    def mark_consumed(self, name: str, fingerprint: str,
+                      vocab_fp: str, meta: Dict[str, Any]) -> None:
+        self.consumed[name] = {
+            "fingerprint": fingerprint,
+            "vocab_fingerprint": vocab_fp,
+            "n_sentences": int(meta.get("n_sentences", 0)),
+            "total_tokens": int(meta.get("total_tokens", 0)),
+        }
+        self.counted.pop(name, None)  # consumed implies counted
+
+
+class ConcatCorpus(Sequence):
+    """Read-only concatenation of several encoded segments — satisfies the
+    ``Sequence[np.ndarray]`` feed contract like one EncodedCorpus."""
+
+    def __init__(self, parts: Iterable[Sequence]):
+        self._parts = [p for p in parts if len(p)]
+        self._offsets = np.cumsum([0] + [len(p) for p in self._parts])
+
+    def __len__(self) -> int:
+        return int(self._offsets[-1])
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if isinstance(i, slice):
+            raise TypeError("ConcatCorpus supports integer indexing only")
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        part = int(np.searchsorted(self._offsets, i, side="right")) - 1
+        return self._parts[part][i - int(self._offsets[part])]
+
+    @property
+    def total_tokens(self) -> int:
+        return sum(int(getattr(p, "total_tokens", 0)) for p in self._parts)
+
+
+def _segment_cache_dir(cache_dir: str, name: str) -> str:
+    return os.path.join(cache_dir, f"{name}.enc")
+
+
+def encode_segment(
+    stream: CorpusStream,
+    name: str,
+    vocab: Vocabulary,
+    cache_dir: str,
+    max_sentence_length: int,
+    allowed_fingerprints: Optional[Sequence[str]] = None,
+) -> EncodedCorpus:
+    """Encode one segment under ``vocab``, reusing the cached encode when it
+    was written under the current vocabulary or any allowed ancestor
+    (``allowed_fingerprints`` — the checkpoint's lineage chain). A cache
+    under a NON-ancestor vocabulary is stale (ids would map to the wrong
+    words) and is re-encoded in place."""
+    enc_dir = _segment_cache_dir(cache_dir, name)
+    want = vocab_fingerprint(vocab)
+    allowed = set(allowed_fingerprints or ()) | {want}
+    if os.path.exists(os.path.join(enc_dir, "meta.json")):
+        enc = EncodedCorpus(enc_dir)
+        got = enc.meta.get("vocab_fingerprint")
+        if got in allowed:
+            return enc  # the common continual case: NOT re-encoded
+        logger.warning(
+            "segment %s encode cache was written under a non-ancestor "
+            "vocabulary (%s); re-encoding under the current one", name, got)
+    return encode_corpus(stream.corpus(name), vocab, enc_dir,
+                         max_sentence_length)
+
+
+def encode_delta(
+    stream: CorpusStream,
+    cursor: StreamCursor,
+    vocab: Vocabulary,
+    cache_dir: str,
+    max_sentence_length: int = 1000,
+    lineage: Optional[Sequence[str]] = None,
+    replay_segments: int = 0,
+) -> Dict[str, Any]:
+    """The delta encode pass: encode only the unconsumed tail under
+    ``vocab``; assemble the increment's training corpus as (optional replay
+    of the most recent consumed segments, from their caches) + (the new
+    tail). Returns::
+
+        {"corpus": ConcatCorpus, "new": [names], "replayed": [names],
+         "encoded": {name: EncodedCorpus for the new tail}}
+    """
+    os.makedirs(cache_dir, exist_ok=True)
+    new_names = cursor.new_segments(stream)
+    encoded: Dict[str, EncodedCorpus] = {}
+    parts: List[EncodedCorpus] = []
+    replayed: List[str] = []
+    if replay_segments > 0:
+        for name in sorted(cursor.consumed)[-replay_segments:]:
+            parts.append(encode_segment(
+                stream, name, vocab, cache_dir, max_sentence_length,
+                allowed_fingerprints=lineage))
+            replayed.append(name)
+    for name in new_names:
+        enc = encode_segment(stream, name, vocab, cache_dir,
+                             max_sentence_length,
+                             allowed_fingerprints=lineage)
+        encoded[name] = enc
+        parts.append(enc)
+    return {"corpus": ConcatCorpus(parts), "new": new_names,
+            "replayed": replayed, "encoded": encoded}

@@ -160,12 +160,28 @@ class Word2Vec:
                 encoded = EncodedCorpus(encode_cache_dir)
                 want = vocab_fingerprint(vocab)
                 got = encoded.meta.get("vocab_fingerprint")
-                if got != want:
+                # the continual case (docs/continual.md): a checkpoint grown
+                # by continual.extend carries a vocab_lineage chain whose
+                # identity-prefix contract keeps every ANCESTOR vocabulary's
+                # ids valid — a cache encoded under any of them is reused
+                # as-is, not re-encoded
+                from glint_word2vec_tpu.continual.extend import (
+                    lineage_fingerprints)
+                allowed = set(
+                    lineage_fingerprints(header.get("vocab_lineage") or []))
+                allowed.add(want)
+                if got not in allowed:
                     raise ValueError(
                         f"encode_cache_dir {encode_cache_dir!r} was encoded under a "
                         f"different vocabulary (fingerprint {got} != checkpoint's "
-                        f"{want}); ids would map to the wrong words. Point resume at "
-                        "the cache dir of the interrupted run, or a fresh directory.")
+                        f"{want}, and it is not an ancestor in the checkpoint's "
+                        "lineage chain); ids would map to the wrong words. Point "
+                        "resume at the cache dir of the interrupted run, or a "
+                        "fresh directory — or, if the CORPUS drifted (new words, "
+                        "shifted frequencies), migrate the checkpoint first with "
+                        "glint_word2vec_tpu.continual.extend.extend_checkpoint "
+                        "(vocab growth on resume, docs/continual.md) instead of "
+                        "retraining from scratch.")
             else:
                 encoded = encode_corpus(
                     sentences, vocab, encode_cache_dir, cfg.max_sentence_length)

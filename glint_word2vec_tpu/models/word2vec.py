@@ -224,8 +224,23 @@ class Word2VecModel:
         so :meth:`find_synonyms_batch` can serve the approximate arm
         (``ann=True``). The exact path stays the ground-truth oracle; the
         index is a serving-time accessory, never persisted with the model
-        (it rebuilds from the matrix at load/publish time)."""
+        (it rebuilds from the matrix at load/publish time).
+
+        Refuses an index whose row count differs from the vocabulary — with
+        continual publishes the vocabulary GROWS across reloads, and a stale
+        index carried over from the previous generation would silently
+        mis-rank (new rows unreachable, row-id → word lookups shifted only
+        by luck of the identity-prefix contract). A vocab-size change forces
+        a full rebuild by construction."""
         self._check_alive()
+        if index is not None:
+            rows = getattr(index, "num_rows", None)
+            if rows is not None and int(rows) != self.vocab.size:
+                raise ValueError(
+                    f"ANN index covers {rows} rows but the vocabulary has "
+                    f"{self.vocab.size} words — a stale index from a "
+                    f"previous publish (the vocabulary grew?); rebuild with "
+                    f"serve.ann.build_ivf(np.asarray(model.syn0))")
         self._ann = index
 
     @property

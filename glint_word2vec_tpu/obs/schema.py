@@ -111,6 +111,22 @@ KINDS: Dict[str, Dict[str, tuple]] = {
         "refused": (int,),
         "reloads": (int,),
     },
+    # --- continual-training record kinds (continual/loop.py; ADDITIVE under
+    # the schema evolution rule, like the serve_* tier: brand-new kinds, no
+    # existing field moved — archived v1 logs keep validating) ---
+    "continual_extend": {
+        "old_vocab_size": (int,),
+        "new_vocab_size": (int,),
+        "new_words": (int,),     # promoted past min_count this migration
+    },
+    "continual_increment": {
+        "increment": (int,),     # 0 = the bootstrap base fit
+        "segments": (int,),      # new tail segments trained this increment
+        "vocab_size": (int,),    # AFTER any extension
+        "new_words": (int,),
+        "words": (int,),         # tail tokens trained
+        "train_seconds": _NUM,
+    },
 }
 
 _COMMON = {"schema": (int,), "kind": (str,), "t": _NUM}
@@ -141,6 +157,9 @@ KINDS_OPTIONAL: Dict[str, Dict[str, tuple]] = {
     },
     "serve_reload": {
         "ann": (dict,),
+        "vocab_grew_from": (int,),  # previous generation's V, present only
+                                    # when the publish changed the vocab
+                                    # size (continual growth)
     },
     "serve_stats": {
         "latency_ms": (dict,),   # p50/p95/p99 over the recent-latency ring

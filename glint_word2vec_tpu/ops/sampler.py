@@ -108,6 +108,19 @@ def build_alias_table(counts: np.ndarray, power: float = 0.75,
     partition count is a constant, never the worker count, so the table is
     deterministic per (counts, power) at ANY ``workers``; partitions touch
     disjoint index sets, so concurrent in-place writes never overlap.
+
+    **Rebuild vs incremental (continual training, docs/continual.md):** a
+    vocab extension / counts merge REBUILDS the table from the merged
+    counts rather than patching the old one — there is no incremental
+    update path, by design. The rebuilt table is *distribution-exact* for
+    the merged counts (the alias construction is exact for any counts;
+    pinned by the implied-distribution equality test at an extended vocab,
+    tests/test_continual.py), but the (prob, alias) PAIRING differs from
+    the old table's, so the REALIZED negative-sample stream after an
+    increment is not a continuation of the pre-increment stream — the same
+    cross-release caveat as the round-8 vectorized builder (PERF.md §10,
+    config.io_workers note). Continual increments may legally change the
+    negative stream; only the sampled distribution is contractual.
     """
     counts = np.asarray(counts, dtype=np.float64)
     if counts.ndim != 1 or counts.size == 0:

@@ -56,7 +56,16 @@ def load_with_retry(path: str, plan=None, attempts: int = 8,
     phase), indistinguishable from bit rot on one attempt but healed on
     retry; REAL corruption keeps failing and still raises once the budget
     is spent. Permanent problems (bad mesh for the shard layout) surface
-    immediately."""
+    immediately.
+
+    Continual publishes make the cross-publish torn read CONCRETE: when the
+    vocabulary grew between publish N and N+1 (continual/extend.py), a
+    straddling reader sees publish N+1's metadata (vocab_size = V_new) with
+    publish N's words sidecar or arrays (V_old entries) — exactly the
+    loader's vocab_size/words-mismatch ValueErrors retried below. A load
+    that SUCCEEDED is therefore always one self-consistent publish; the
+    V-grew case is driven in the serve-reload and continual-drift chaos
+    phases."""
     from glint_word2vec_tpu.models.word2vec import Word2VecModel
     from glint_word2vec_tpu.train.checkpoint import CheckpointCorruptError
     last: Optional[BaseException] = None

@@ -110,6 +110,10 @@ class EmbeddingService:
             self._handle = ServingHandle(model, index)
             self._load_seconds = time.perf_counter() - t0
             self.reloads = 0
+            # cross-publish vocab-change tracking (continual training grows
+            # V; docs/continual.md): count reloads that changed the size
+            self.vocab_change_reloads = 0
+            self._served_vocab_size = model.num_words
             self._batcher = BatchingScheduler(
                 self._dispatch,
                 max_batch=int(_knob(model, "serve_max_batch", max_batch)),
@@ -161,11 +165,27 @@ class EmbeddingService:
 
     def _load_and_swap(self) -> Any:
         """Load the newest checkpoint + build its index IN THE BACKGROUND
-        (the current model keeps serving), then atomically swap."""
+        (the current model keeps serving), then atomically swap.
+
+        A vocab-size change across publishes (the continual-training loop
+        grows V, docs/continual.md) is detected and counted: the index is
+        rebuilt from scratch at the new V on every reload by construction
+        (never carried over — ``attach_ann`` additionally refuses a
+        row-count mismatch as the hard guard), and the count surfaces in
+        :meth:`stats` so a fleet dashboard can see growth propagating."""
         t0 = time.perf_counter()
         model = load_with_retry(self._checkpoint, plan=self._plan)
         index = self._build_index(model)
+        prev_v = self._served_vocab_size
+        vocab_changed = prev_v is not None and model.num_words != prev_v
         self._handle.swap(model, index)
+        self._served_vocab_size = model.num_words
+        if vocab_changed:
+            self.vocab_change_reloads += 1
+            logger.info(
+                "hot-reload: vocabulary changed %d -> %d words; ANN index "
+                "fully rebuilt at the new vocabulary", prev_v,
+                model.num_words)
         self.reloads += 1
         self._load_seconds = time.perf_counter() - t0
         if self._sink is not None:
@@ -173,6 +193,8 @@ class EmbeddingService:
                             vocab_size=model.num_words,
                             reloads=self.reloads,
                             load_seconds=round(self._load_seconds, 3),
+                            **({"vocab_grew_from": prev_v}
+                               if vocab_changed else {}),
                             **({"ann": index.stats} if index else {}))
         logger.info("hot-reload %d: %d words in %.2fs (in-flight batches "
                     "finished on the old model)", self.reloads,
@@ -275,6 +297,7 @@ class EmbeddingService:
     def stats(self) -> Dict[str, Any]:
         snap = self._batcher.stats()
         snap["reloads"] = self.reloads
+        snap["vocab_change_reloads"] = self.vocab_change_reloads
         snap["models_released"] = self._handle.models_released
         snap["load_seconds"] = round(self._load_seconds, 3)
         with self._handle.lease() as (model, index):

@@ -157,6 +157,25 @@ def _format_version(base: int, train_state: Optional["TrainState"]) -> int:
     return base
 
 
+# keys the checkpoint writers own; extra_metadata may not shadow them — a
+# caller-supplied "digests" or "config" would silently corrupt the contract
+_RESERVED_META_KEYS = frozenset({
+    "format_version", "framework", "layout", "vocab_size", "vector_size",
+    "padded_vocab", "padded_dim", "config", "train_state", "digests"})
+
+
+def _merge_extra_metadata(meta: Dict[str, Any],
+                          extra: Optional[Dict[str, Any]]) -> None:
+    if not extra:
+        return
+    clash = sorted(_RESERVED_META_KEYS & set(extra))
+    if clash:
+        raise ValueError(
+            f"extra_metadata may not shadow writer-owned metadata keys "
+            f"{clash}; pick different names")
+    meta.update(extra)
+
+
 @dataclasses.dataclass
 class TrainState:
     """Mid-training progress: which iteration we are in and how many (subsampled) words
@@ -221,6 +240,7 @@ def save_model(
     syn1: Optional[np.ndarray],
     config: Word2VecConfig,
     train_state: Optional[TrainState] = None,
+    extra_metadata: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Atomic save: everything is written to a sibling temp directory first and swapped
     into place, so a crash mid-save never corrupts an existing checkpoint (the whole point
@@ -232,7 +252,12 @@ def save_model(
     (:class:`_HashingWriter` — one sequential pass per file, not write + re-
     read), and the four independent file writes fan out over
     ``config.io_workers`` threads. The bytes on disk and the digest map are
-    identical at any worker count."""
+    identical at any worker count.
+
+    ``extra_metadata``: additive keys merged into ``metadata.json`` (readers
+    ignore unknown keys — no format bump). The continual subsystem rides
+    this for the ``vocab_lineage`` chain (continual/extend.py); reserved
+    keys (anything :func:`load_model_header` already reads) are refused."""
     bad = [w for w in words if (not w) or ("\n" in w)]
     if bad:
         raise ValueError(
@@ -272,6 +297,7 @@ def save_model(
             "train_state": (train_state or TrainState(finished=True)).to_dict(),
             "digests": digests,
         }
+        _merge_extra_metadata(meta, extra_metadata)
         with open(stage("metadata.json"), "w", encoding="utf-8") as f:
             json.dump(meta, f, indent=2)
         faults.crash_point("save:staged")
@@ -335,6 +361,7 @@ def save_model_sharded(
     train_state: Optional[TrainState] = None,
     vocab_size: Optional[int] = None,
     vector_size: Optional[int] = None,
+    extra_metadata: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Row-shards save: every process writes its own rows, process 0 writes metadata
     and swaps the directory into place after a cross-process barrier. Single-process
@@ -419,6 +446,7 @@ def save_model_sharded(
                 "train_state": (train_state or TrainState(finished=True)).to_dict(),
                 "digests": digests,
             }
+            _merge_extra_metadata(meta, extra_metadata)
             with open(os.path.join(tmp, "metadata.json"), "w", encoding="utf-8") as f:
                 json.dump(meta, f, indent=2)
             faults.crash_point("save:staged")
@@ -742,6 +770,10 @@ def load_model_header(path: str) -> Dict[str, Any]:
         "vector_size": meta.get("vector_size"),
         "config": Word2VecConfig.from_dict(meta["config"]),
         "train_state": TrainState.from_dict(meta.get("train_state", {})),
+        # continual-training provenance (continual/extend.py): the chain of
+        # vocabulary migrations this checkpoint descends from; [] on
+        # checkpoints that never grew
+        "vocab_lineage": meta.get("vocab_lineage", []),
     }
 
 

@@ -389,6 +389,12 @@ class Trainer:
                 {"syn0": np.asarray(params.syn0), "syn1": np.asarray(params.syn1)})
             self.params = EmbeddingPair(placed["syn0"], placed["syn1"])
         self.state = train_state or TrainState()
+        # additive checkpoint-metadata keys (train/checkpoint.py
+        # extra_metadata) merged into EVERY save this trainer performs —
+        # periodic and final alike. Owned by drivers above the trainer (the
+        # continual loop records its vocab_lineage chain here); empty = the
+        # pre-continual metadata, byte-identical.
+        self.extra_checkpoint_meta: dict = {}
         # Chunk transfer layout (see chunk_stream in fit): pairs ride in ONE packed
         # array per dispatch — through a narrow host→device link the per-transfer
         # overhead dominates, so fewer/larger puts win. Indices ship as uint16 when the
@@ -1229,16 +1235,32 @@ class Trainer:
         checkpoint_path: Optional[str] = None,
         checkpoint_every_steps: Optional[int] = None,
         on_heartbeat: Optional[Callable[[HeartbeatRecord], None]] = None,
+        corpus_words: Optional[int] = None,
     ) -> EmbeddingPair:
         """Run the remaining iterations of training over encoded sentences.
 
         ``sentences``: int32 index arrays (already OOV-filtered and chunked — C4 output).
         Resumes from ``self.state`` if a prior checkpoint set it.
+
+        ``corpus_words``: raw token count of ``sentences``, when it differs
+        from what the vocabulary's counts imply — the continual case
+        (docs/continual.md), where an incremental fit feeds only the corpus
+        TAIL while ``vocab.counts`` carries the full merged history. The
+        lr-decay clock then anneals over the fed corpus (scaled by the same
+        expected-subsample-keep ratio), not over a history-sized total it
+        would never reach. Default None = the corpus is the vocabulary's
+        source (every non-continual fit), behavior unchanged.
         """
         cfg = self.config
         from glint_word2vec_tpu.data.pipeline import expected_kept_words
         train_words = expected_kept_words(
             self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
+        if corpus_words is not None:
+            # per-iteration expected KEPT words of the fed corpus: the
+            # vocab-wide keep ratio applied to the fed token count
+            train_words = (train_words
+                           / max(float(self.vocab.train_words_count), 1.0)
+                           * float(corpus_words))
         total_words = float(cfg.num_iterations * train_words + 1)
         K = max(1, cfg.steps_per_dispatch)
         # banded CBOW rides the token-block feed paths (same chunk plumbing as
@@ -3335,17 +3357,22 @@ class Trainer:
             # the round pays one probe, not two
             self._nonfinite_guard(_channels)
         from glint_word2vec_tpu.parallel.distributed import is_multiprocess
+        # additive metadata every save carries (periodic saves included, so a
+        # SIGTERM mid-increment leaves the provenance in place): the continual
+        # driver parks the vocab_lineage chain here (continual/loop.py)
+        extra = self.extra_checkpoint_meta or None
         if self.config.sharded_checkpoint or is_multiprocess():
             # row-shards layout: each process writes its own rows, no host gather
             from glint_word2vec_tpu.train.checkpoint import save_model_sharded
             save_model_sharded(
                 path, self.vocab.words, self.vocab.counts,
                 self.params.syn0, self.params.syn1, self.config, self.state,
-                vocab_size=self.vocab.size, vector_size=self.config.vector_size)
+                vocab_size=self.vocab.size, vector_size=self.config.vector_size,
+                extra_metadata=extra)
         else:
             p = self.unpadded_params()
             save_model(
                 path, self.vocab.words, self.vocab.counts,
                 np.asarray(p.syn0), np.asarray(p.syn1),
-                self.config, self.state)
+                self.config, self.state, extra_metadata=extra)
         logger.info("checkpoint saved to %s at step %d", path, self.global_step)

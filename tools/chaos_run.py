@@ -39,7 +39,18 @@ Every fault is deterministic (train/faults.py) — no sleep/kill-timing races:
    while a query storm runs against an EmbeddingService watching the same
    path — zero failed/refused queries, ≥ 3 observed hot-reloads, and every
    superseded model's buffers released once its in-flight leases drained.
-8. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
+   The epilogue drives the cross-publish V-GREW case (ISSUE 11): the
+   checkpoint is vocabulary-extended mid-storm, the service must hot-reload
+   at the new V (index rebuilt, ``vocab_change_reloads`` counted) and answer
+   a query for a word that did not exist one publish earlier.
+8. **continual-drift** — the closed continual loop (ISSUE 11,
+   docs/continual.md): base fit → corpus append with unseen words → a
+   SIGTERM'd mid-increment driver subprocess must leave a resumable
+   published checkpoint and an unconsumed cursor → the retried increment
+   grows V with the fingerprint lineage recorded → a live serve replica
+   hot-reloads the grown model, answers a query for a NEW word, and an old
+   word's neighbors stay inside its co-occurrence cluster.
+9. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
    exponential-backoff wrapper in ``data/`` must absorb them.
 
 Usage::
@@ -464,8 +475,139 @@ def phase_serve_reload(workdir: str, n_sentences: int) -> str:
                     f"{stats['models_released']} old models released")
         if queries[0] < 50:
             return f"storm too thin ({queries[0]} queries) to prove overlap"
+
+        # cross-publish V-GREW epilogue (ISSUE 11): extend the vocabulary
+        # between publishes; the watcher must hot-reload at the new V with
+        # a freshly built index and serve the brand-new word
+        from glint_word2vec_tpu.continual import extend_checkpoint
+        rep = extend_checkpoint(
+            ck, {"brandnew0": 50, "brandnew1": 40}, min_count=1)
+        deadline = time.monotonic() + 30
+        while (service.info()["num_words"] != rep["new_vocab_size"]
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        info = service.info()
+        if info["num_words"] != rep["new_vocab_size"]:
+            return (f"service never reloaded the V-grew publish "
+                    f"(serving {info['num_words']} words, want "
+                    f"{rep['new_vocab_size']})")
+        if service.stats()["vocab_change_reloads"] < 1:
+            return "V-grew reload not counted as a vocab change"
+        res = service.synonyms("brandnew0", 3)
+        if not res or not all(np.isfinite(s) for _, s in res):
+            return f"new-vocab word query failed after the V-grew reload: {res}"
     finally:
         service.close()
+    return ""
+
+
+def phase_continual_drift(workdir: str, n_sentences: int) -> str:
+    """The closed continual loop under fault injection (ISSUE 11,
+    docs/continual.md): base fit -> corpus append with unseen words -> a
+    SIGTERM'd mid-increment driver must leave a RESUMABLE published
+    checkpoint and an unconsumed cursor -> the retried increment grows V
+    (lineage recorded, carried rows verified by the extension itself) -> a
+    live serve replica hot-reloads the grown model and answers a query for
+    a NEW word, with an old word's neighbors still in its cluster."""
+    import json as _json
+    import time
+
+    from glint_word2vec_tpu.continual import ContinualRunner, StreamCursor
+    from glint_word2vec_tpu.serve import EmbeddingService
+    from glint_word2vec_tpu.train.checkpoint import (
+        load_latest_valid, load_model_header, verify_checkpoint)
+    from tools.continual_run import (
+        _CLUSTER_A, _NEW_WORDS, _write_cluster_segment)
+
+    corpus_dir = os.path.join(workdir, "corpus")
+    work_dir = os.path.join(workdir, "work")
+    ck = os.path.join(workdir, "publish", "ck")
+    os.makedirs(corpus_dir, exist_ok=True)
+    _write_cluster_segment(
+        os.path.join(corpus_dir, "seg-000.txt"), n_sentences, seed=1)
+    overrides = dict(
+        vector_size=16, min_count=2, window=3, num_iterations=2,
+        pairs_per_batch=128, subsample_ratio=0.0, seed=1,
+        prefetch_chunks=0, steps_per_dispatch=2, heartbeat_every_steps=2)
+    runner = ContinualRunner(ck, corpus_dir, work_dir,
+                             config_overrides=overrides,
+                             checkpoint_every_steps=4)
+    base = runner.ensure_base()
+    v_base = base["vocab_size"]
+    _write_cluster_segment(
+        os.path.join(corpus_dir, "seg-001.txt"), n_sentences, seed=2,
+        extra_a_words=_NEW_WORDS)
+
+    # 1. SIGTERM mid-increment: the subprocess driver extends + starts the
+    #    incremental fit, then dies at a scripted step. crash_at_step fires
+    #    on global_step, which CONTINUES from the base checkpoint — 1 is
+    #    already exceeded, so the first fit round of the increment dies.
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               GLINT_FAULT_CRASH_AT_STEP="1",
+               GLINT_FAULT_CRASH_SIGNAL="TERM")
+    rc = subprocess.call(
+        [sys.executable,
+         os.path.join(_REPO, "tools", "continual_run.py"),
+         "--checkpoint", ck, "--corpus-dir", corpus_dir,
+         "--work-dir", work_dir, "--max-increments", "1",
+         "--idle-polls", "1"],
+        env=env, stdout=subprocess.DEVNULL)
+    if rc not in (-15, 143):
+        return f"driver exited {rc}, expected SIGTERM (-15/143)"
+    # resumable: the publish path (or its swap debris) verifies, and the
+    # cursor did NOT consume the tail — the increment will retry
+    try:
+        recovered = load_latest_valid(os.path.dirname(ck))
+        verify_checkpoint(recovered)
+    except Exception as e:  # noqa: BLE001 — unrecoverable = the failure
+        return f"no resumable checkpoint after mid-increment SIGTERM: {e}"
+    cursor = StreamCursor(work_dir)
+    if "seg-001.txt" in cursor.consumed:
+        return "SIGTERM'd increment was marked consumed (not resumable)"
+
+    # 2. retry the increment in-process, with a live serve replica watching
+    service = EmbeddingService(
+        checkpoint=ck, ann=True, watch=True, reload_poll_s=0.05,
+        max_batch=16, max_delay_ms=1.0)
+    try:
+        runner2 = ContinualRunner(ck, corpus_dir, work_dir,
+                                  config_overrides=overrides,
+                                  checkpoint_every_steps=4)
+        rep = runner2.run_once()
+        if rep["action"] != "increment":
+            return f"retried increment did not run: {rep}"
+        header = load_model_header(ck)
+        if header["vocab_size"] <= v_base:
+            return (f"vocab did not grow across the increment "
+                    f"({v_base} -> {header['vocab_size']})")
+        lineage = header["vocab_lineage"]
+        if not lineage or lineage[0].get("remap") != "identity-prefix":
+            return f"fingerprint lineage missing/wrong: {lineage}"
+        deadline = time.monotonic() + 30
+        while (service.info()["num_words"] != header["vocab_size"]
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        if service.info()["num_words"] != header["vocab_size"]:
+            return "serve replica never hot-reloaded the grown model"
+        res = service.synonyms(_NEW_WORDS[0], 4)
+        if not res or not all(np.isfinite(s) for _, s in res):
+            return f"new-word query failed on the grown model: {res}"
+        old = service.synonyms(_CLUSTER_A[0], 4)
+        a_like = set(_CLUSTER_A) | set(_NEW_WORDS)
+        if sum(1 for w, _ in old if w in a_like) < 2:
+            return (f"old word {_CLUSTER_A[0]!r} lost its cluster after "
+                    f"the increment: {old}")
+        if service.stats()["refused"]:
+            return "queries refused during the continual publishes"
+        # the cursor JSON round-trips (the next driver run starts clean)
+        with open(os.path.join(work_dir, "cursor.json")) as f:
+            doc = _json.load(f)
+        if "seg-001.txt" not in doc.get("consumed", {}):
+            return "completed increment did not consume its segment"
+    finally:
+        service.close()
+        runner.close()
     return ""
 
 
@@ -525,6 +667,9 @@ def main() -> int:
          lambda: phase_blackbox(os.path.join(workdir, "p5"), n_sentences)),
         ("serve-reload",
          lambda: phase_serve_reload(os.path.join(workdir, "p6"), n_sentences)),
+        ("continual-drift",
+         lambda: phase_continual_drift(os.path.join(workdir, "p7"),
+                                       min(n_sentences, 400))),
         ("flaky-ingest",
          lambda: phase_flaky_ingest(os.path.join(workdir, "p4"))),
     ]

@@ -536,6 +536,25 @@ def main():
                     choices=["off", "warn", "recover", "halt"],
                     help="finite-blowup watchdog policy for the trained run "
                          "('recover' = the full auto-recovery ladder)")
+    # --- continual forgetting gate (ISSUE 11 / docs/continual.md): train a
+    # base model, run ONE continual increment over a drifted corpus tail
+    # (new word types + shifted frequencies), and score the ORIGINAL
+    # vocabulary's purity/analogy before and after — catastrophic
+    # forgetting as a gated number (two EVAL_RUNS rows), not a vibe ---
+    ap.add_argument("--continual-ab", action="store_true",
+                    help="base fit -> one continual increment on a drifted "
+                         "tail -> score the ORIGINAL vocab pre/post; emits "
+                         "one EVAL_RUNS row per arm (continual_ab_arm="
+                         "pre/post)")
+    ap.add_argument("--continual-tail-words", type=int, default=None,
+                    help="drift-tail size in words (default: --words // 4)")
+    ap.add_argument("--continual-new-types", type=int, default=2000,
+                    help="extra raw word types in the tail generator "
+                         "(ranks past --vocab become NEW words)")
+    ap.add_argument("--continual-lr-rewarm", type=float, default=1.0,
+                    help="continual_lr_rewarm for the increment")
+    ap.add_argument("--continual-iterations", type=int, default=1,
+                    help="continual_iterations for the increment")
     ap.add_argument("--stab-ab", action="store_true",
                     help="train TWO arms on the identical corpus/seed — the "
                          "unmitigated baseline (all stabilizers off, "
@@ -700,6 +719,108 @@ def main():
             with open(os.path.join(repo_root, "EVAL_RUNS.jsonl"), "a") as f:
                 f.write(json.dumps(result) + "\n")
         return result
+
+    if args.continual_ab:
+        if args.corpus:
+            ap.error("--continual-ab needs the synthetic ground-truth corpus "
+                     "(external corpora have no labels to score forgetting "
+                     "against)")
+        import shutil
+
+        from glint_word2vec_tpu.continual import ContinualRunner
+        from glint_word2vec_tpu.models.word2vec import Word2VecModel
+
+        est = Word2Vec(
+            vector_size=args.dim, min_count=args.min_count, window=5,
+            negatives=5, negative_pool=args.pool,
+            pairs_per_batch=args.batch, steps_per_dispatch=32,
+            num_iterations=args.iters, learning_rate=lr,
+            subsample_ratio=args.subsample, seed=args.seed,
+            param_dtype=args.param_dtype, compute_dtype=args.param_dtype,
+            logits_dtype=args.logits_dtype or "float32",
+            allow_unstable=True, device_pairgen=args.device_pairgen,
+            cbow=args.cbow,
+            continual_lr_rewarm=args.continual_lr_rewarm,
+            continual_iterations=args.continual_iterations)
+        t0 = time.perf_counter()
+        model = est.fit(sents, encode_cache_dir=cache_dir)
+        base_s = round(time.perf_counter() - t0, 1)
+        words_base = list(model.vocab.words)
+        index_base = dict(model.vocab.index)
+        v_base = model.num_words
+        log(f"continual-ab base: vocab {v_base:,} in {base_s}s")
+        common = {
+            "metric": "topic_recovery_at_text8_scale",
+            "corpus_words": args.words, "vocab_raw": args.vocab,
+            "vocab_size": v_base, "dim": args.dim,
+            "iterations": args.iters, "param_dtype": args.param_dtype,
+            "logits_dtype": args.logits_dtype or "float32",
+            "pairs_per_batch": args.batch, "negative_pool": args.pool,
+            "subsample_ratio": args.subsample, "min_count": args.min_count,
+            "learning_rate": lr, "rel_sent_frac": REL_SENT_FRAC,
+            "rel_lambda_entity": REL_LAMBDA_ENTITY,
+            "rel_lambda_role": REL_LAMBDA_ROLE,
+            "continual_tail_words": (args.continual_tail_words
+                                     or args.words // 4),
+            "continual_new_types": args.continual_new_types,
+            "continual_lr_rewarm": args.continual_lr_rewarm,
+            "continual_iterations": args.continual_iterations,
+        }
+        row_pre = {**common, "continual_ab_arm": "pre",
+                   "train_seconds_total": base_s}
+        row_pre.update(evaluate(
+            words_base, np.asarray(model.syn0, np.float32), index_base))
+
+        croot = os.path.join(args.out, "continual")
+        shutil.rmtree(croot, ignore_errors=True)
+        ckpath = os.path.join(croot, "publish", "ck")
+        model.save(ckpath)
+        stream_dir = os.path.join(croot, "stream")
+        os.makedirs(stream_dir, exist_ok=True)
+        # the drifted tail: extra raw types past --vocab are NEW words
+        # (their names encode their topics, so ground truth still travels
+        # with the corpus); Zipf over the larger support shifts every
+        # surviving word's frequency too
+        generate_corpus(
+            os.path.join(stream_dir, "seg-001.txt"),
+            common["continual_tail_words"], args.seed + 1000,
+            args.vocab + args.continual_new_types)
+        runner = ContinualRunner(
+            ckpath, stream_dir, os.path.join(croot, "work"),
+            config_overrides=dict(
+                allow_unstable=True,
+                continual_lr_rewarm=args.continual_lr_rewarm,
+                continual_iterations=args.continual_iterations))
+        inc = runner.run_once()
+        runner.close()
+        log(f"continual-ab increment: {inc}")
+        post = Word2VecModel.load(ckpath)
+        emb_post = np.asarray(post.syn0, np.float32)[:v_base]
+        row_post = {**common, "continual_ab_arm": "post",
+                    "continual_new_words": inc["new_words"],
+                    "continual_vocab_size": inc["vocab_size"],
+                    "train_seconds_total": inc["train_seconds"]}
+        # scored over the ORIGINAL vocabulary's rows only — the identity-
+        # prefix contract makes emb_post[:v_base] exactly those words
+        row_post.update(evaluate(words_base, emb_post, index_base))
+        repo_root = os.path.dirname(_here)
+        with open(os.path.join(repo_root, "EVAL_RUNS.jsonl"), "a") as f:
+            f.write(json.dumps(row_pre) + "\n")
+            f.write(json.dumps(row_post) + "\n")
+        delta = None
+        if "purity_at_10" in row_pre and "purity_at_10" in row_post:
+            delta = round(row_post["purity_at_10"] - row_pre["purity_at_10"],
+                          4)
+        print(json.dumps({
+            "metric": "continual_ab", "purity_delta": delta,
+            "purity_pre": row_pre.get("purity_at_10"),
+            "purity_post": row_post.get("purity_at_10"),
+            "analogy_pre": row_pre.get("analogy_accuracy_at_1"),
+            "analogy_post": row_post.get("analogy_accuracy_at_1"),
+            "vocab_base": v_base, "vocab_grown": inc["vocab_size"],
+            "new_words": inc["new_words"],
+            "arms": [row_pre, row_post]}))
+        return
 
     stab = dict(max_row_norm=args.max_row_norm, update_clip=args.update_clip,
                 row_l2=args.row_l2, norm_watch=args.norm_watch)

@@ -23,7 +23,7 @@ BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # at least one of these (docs/configuration.md is the canonical table)
 _DOC_FILES = ("docs/configuration.md", "README.md", "docs/static-analysis.md",
               "docs/robustness.md", "docs/observability.md",
-              "docs/sharding.md", "docs/serving.md")
+              "docs/sharding.md", "docs/serving.md", "docs/continual.md")
 
 
 def log(msg: str) -> None:

@@ -134,6 +134,16 @@ KNOBS = {k.name: k for k in [
     _K("serve_ann_nprobe", (0, 1, 64), invalid=-1, auto=0,
        dispatch_inert=True),
     _K("serve_reload_poll_s", (0.05, 0.5), invalid=0.0, dispatch_inert=True),
+    # --- continual-training knobs (continual/, docs/continual.md): read
+    # only by the continual driver (ContinualRunner), never by trainer
+    # construction or dispatch — dispatch-inert by construction, like the
+    # serve_* tier ---
+    _K("continual_min_new_words", (1, 100), invalid=0, dispatch_inert=True),
+    _K("continual_lr_rewarm", (0.5, 1.0), invalid=0.0, dispatch_inert=True),
+    _K("continual_iterations", (1, 3), invalid=0, dispatch_inert=True),
+    _K("continual_replay_segments", (0, 2), invalid=-1,
+       dispatch_inert=True),
+    _K("continual_poll_s", (0.05, 2.0), invalid=0.0, dispatch_inert=True),
 ]}
 
 

@@ -439,6 +439,7 @@ class R7JsonStdout:
         "tools/collectives.py", "tools/shard_ab.py", "tools/stepaudit.py",
         "tools/telemetry_run.py", "tools/graftcheck/__main__.py",
         "tools/run_report.py", "tools/perfgate.py", "tools/servebench.py",
+        "tools/continual_run.py",
     }
 
     def applies(self, path: str) -> bool:
