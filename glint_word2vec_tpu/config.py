@@ -550,6 +550,56 @@ class Word2VecConfig:
     serve_reload_poll_s: float = 0.5  # hot-reload watcher poll cadence over
                                     # the checkpoint publish signal
                                     # (metadata.json identity; serve/reload.py)
+    # --- serving fleet (docs/serving.md §5; serve/fleet.py — read by the
+    # fleet ROUTER process (FleetRouter / tools/fleet_run.py), never by the
+    # trainer or a single replica: dispatch-inert by construction, same
+    # contract as the serve_* tier above. The knobs travel with the
+    # checkpoint so a deployment's fleet policy is pinned beside the model
+    # it serves; FleetRouter constructor arguments override per process. ---
+    serve_fleet_replicas: int = 3   # replica processes behind the router;
+                                    # the rolling-reload capacity floor is
+                                    # N-1, so N >= 2 is where the fleet
+                                    # starts buying anything (N = 1 is the
+                                    # single-service deployment with router
+                                    # overhead — allowed, benched as the
+                                    # baseline arm in servebench --fleet)
+    serve_fleet_probe_s: float = 0.5  # health-probe cadence: the router's
+                                    # prober sends each replica a cheap
+                                    # stats op this often (liveness +
+                                    # publish-generation staleness; an
+                                    # OPEN breaker's half-open trial rides
+                                    # the same tick, so recovery costs
+                                    # zero client queries)
+    serve_fleet_breaker_failures: int = 3  # consecutive failures/timeouts
+                                    # that open a replica's circuit
+                                    # breaker (closed -> open); client
+                                    # traffic routes only to CLOSED
+                                    # breakers
+    serve_fleet_breaker_reset_s: float = 2.0  # open-breaker cooldown before
+                                    # the half-open trial probe; trial
+                                    # success closes the breaker, failure
+                                    # reopens it and re-arms the cooldown
+    serve_fleet_hedge_ms: float = -1.0  # tail-latency hedging delay: a
+                                    # single query unanswered past this
+                                    # many ms goes to a SECOND replica,
+                                    # first response wins (the loser's
+                                    # reply is discarded). -1 (default) =
+                                    # AUTO: derive from the router's own
+                                    # measured p99 (re-derived every 64
+                                    # samples, floored at 2 ms — hedges
+                                    # stay rare by construction). 0 = off.
+                                    # Cheap because the CIKM'16 discipline
+                                    # keeps per-request payloads tiny
+                                    # (PAPER.md §0)
+    serve_fleet_retry_deadline_s: float = 10.0  # per-request retry budget:
+                                    # failed attempts retry on OTHER
+                                    # replicas (decorrelated-jitter
+                                    # backoff once all were tried) until
+                                    # this deadline, then the request
+                                    # fails with NoHealthyReplicas.
+                                    # ServerOverloaded replies don't burn
+                                    # backoff — they mark the replica
+                                    # saturated and move on immediately
 
     # --- continual training (docs/continual.md; continual/ — read by the
     # continual DRIVER (ContinualRunner / tools/continual_run.py), never by
@@ -982,6 +1032,31 @@ class Word2VecConfig:
             raise ValueError(
                 f"serve_reload_poll_s must be positive "
                 f"but got {self.serve_reload_poll_s}")
+        if self.serve_fleet_replicas <= 0:
+            raise ValueError(
+                f"serve_fleet_replicas must be positive "
+                f"but got {self.serve_fleet_replicas}")
+        if self.serve_fleet_probe_s <= 0:
+            raise ValueError(
+                f"serve_fleet_probe_s must be positive "
+                f"but got {self.serve_fleet_probe_s}")
+        if self.serve_fleet_breaker_failures <= 0:
+            raise ValueError(
+                f"serve_fleet_breaker_failures must be positive "
+                f"but got {self.serve_fleet_breaker_failures}")
+        if self.serve_fleet_breaker_reset_s <= 0:
+            raise ValueError(
+                f"serve_fleet_breaker_reset_s must be positive "
+                f"but got {self.serve_fleet_breaker_reset_s}")
+        if self.serve_fleet_hedge_ms < 0 and self.serve_fleet_hedge_ms != -1.0:
+            raise ValueError(
+                f"serve_fleet_hedge_ms must be -1 (auto: p99-derived), "
+                f"0 (off), or a positive delay in ms "
+                f"but got {self.serve_fleet_hedge_ms}")
+        if self.serve_fleet_retry_deadline_s <= 0:
+            raise ValueError(
+                f"serve_fleet_retry_deadline_s must be positive "
+                f"but got {self.serve_fleet_retry_deadline_s}")
         if self.continual_min_new_words <= 0:
             # 0 would make every increment a (pointless) zero-growth
             # extension pass; "never grow" is not a policy this knob

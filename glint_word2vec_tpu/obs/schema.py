@@ -111,6 +111,40 @@ KINDS: Dict[str, Dict[str, tuple]] = {
         "refused": (int,),
         "reloads": (int,),
     },
+    # --- serving-fleet record kinds (serve/fleet.py; ADDITIVE under the
+    # schema evolution rule, like the serve_* tier: brand-new kinds, no
+    # existing field moved — archived v1 logs keep validating) ---
+    "fleet_start": {
+        "replicas": (int,),      # fleet size behind the router
+        "checkpoint": (str,),    # publish path ("<in-memory>" for adopted)
+    },
+    "fleet_breaker": {
+        "replica": (str,),       # replica name (r0, r1, ...)
+        "from_state": (str,),    # "closed" | "open" | "half-open"
+        "to_state": (str,),
+        "reason": (str,),        # bounded human diagnostic
+    },
+    "fleet_reload": {
+        "publishes": (int,),     # rolling-reload rounds AFTER this one
+        "min_serving": (int,),   # lowest serving count during the round
+                                 # (the N-1 capacity-floor assertion)
+        "replicas": (int,),
+        "seconds": _NUM,         # whole-round wall time
+    },
+    "fleet_stats": {
+        "queries": (int,),
+        "failures": (int,),      # requests that exhausted the deadline
+        "retries": (int,),       # failed attempts retried elsewhere
+        "hedges": (int,),        # duplicate sends past the hedge delay
+        "hedge_wins": (int,),    # hedges whose SECOND replica answered first
+        "shed": (int,),          # bulk + single refusals (FleetOverloaded)
+        "healthy": (int,),       # alive replicas with CLOSED breakers
+        "degraded": (int,),      # serving a stale publish generation
+    },
+    "fleet_end": {
+        "queries": (int,),
+        "failures": (int,),
+    },
     # --- continual-training record kinds (continual/loop.py; ADDITIVE under
     # the schema evolution rule, like the serve_* tier: brand-new kinds, no
     # existing field moved — archived v1 logs keep validating) ---
@@ -165,6 +199,9 @@ KINDS_OPTIONAL: Dict[str, Dict[str, tuple]] = {
         "latency_ms": (dict,),   # p50/p95/p99 over the recent-latency ring
         "occupancy_mean": _NUM,  # mean requests per dispatched batch
         "ann": (dict,),
+    },
+    "fleet_stats": {
+        "latency_ms": (dict,),   # router-side end-to-end quantiles
     },
 }
 

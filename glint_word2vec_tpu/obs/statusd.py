@@ -41,16 +41,23 @@ from typing import Callable, Optional
 logger = logging.getLogger("glint_word2vec_tpu")
 
 
-def _gauge(lines: list, name: str, value, labels: str = "") -> None:
+def _gauge(lines: list, name: str, value, labels: str = "",
+           seen: Optional[set] = None) -> None:
     """Append one gauge sample (``# TYPE`` + sample line) to ``lines`` —
-    the shared rendering rule of BOTH exposition surfaces (trainer
-    ``glint_*`` and serving ``glint_serve_*``); None skips, bools render
-    as 0/1."""
+    the shared rendering rule of every exposition surface (trainer
+    ``glint_*``, serving ``glint_serve_*``, fleet); None skips, bools
+    render as 0/1. ``seen``: emit the ``# TYPE`` header only on a metric
+    name's FIRST sample — the text format forbids a second TYPE line for
+    the same name, and label-fanned surfaces (the fleet's per-replica
+    loop) emit many samples per metric."""
     if value is None or isinstance(value, bool):
         value = float(bool(value)) if isinstance(value, bool) else None
     if value is None:
         return
-    lines.append(f"# TYPE {name} gauge")
+    if seen is None or name not in seen:
+        lines.append(f"# TYPE {name} gauge")
+        if seen is not None:
+            seen.add(name)
     lines.append(f"{name}{labels} {float(value):g}")
 
 
@@ -114,6 +121,65 @@ def serve_prometheus_text(snap: dict) -> str:
     for field in ("recall_at_10", "nprobe", "centroids", "build_seconds"):
         if field in ann:
             gauge(f"glint_serve_ann_{field}", ann[field])
+    return "\n".join(lines) + "\n"
+
+
+# breaker state as an ordered gauge: closed is healthy, open is worst —
+# dashboards alert on max() over replicas
+_BREAKER_GAUGE = {"closed": 0, "half-open": 1, "open": 2}
+
+
+def fleet_prometheus_text(snap: dict) -> str:
+    """Render a FLEET snapshot (serve.fleet.FleetRouter.status_snapshot) in
+    Prometheus text format: the fleet-level ``glint_serve_fleet_*`` gauges
+    plus each replica's own ``glint_serve_*`` gauges AGGREGATED fleet-wide
+    under a ``replica`` label (stable contract, docs/serving.md §5) — one
+    scrape of the router sees the whole fleet."""
+    lines: list = []
+    seen: set = set()
+
+    def gauge(name: str, value, labels: str = "") -> None:
+        _gauge(lines, name, value, labels, seen=seen)
+
+    gauge("glint_serve_fleet_up",
+          1.0 if snap.get("status") == "serving" else 0.0)
+    for field in ("queries", "failures", "retries", "hedges", "hedge_wins",
+                  "shed_single", "shed_bulk", "reload_rounds"):
+        gauge(f"glint_serve_fleet_{field}_total", snap.get(field))
+    for field in ("healthy", "degraded", "min_serving_during_reloads"):
+        gauge(f"glint_serve_fleet_{field}", snap.get(field))
+    lat = snap.get("latency_ms") or {}
+    for q in ("p50", "p95", "p99"):
+        if q in lat:
+            gauge("glint_serve_fleet_latency_ms", lat[q],
+                  f'{{quantile="{q}"}}')
+    for name, rep in (snap.get("replicas") or {}).items():
+        lab = f'{{replica="{name}"}}'
+        gauge("glint_serve_fleet_breaker_state",
+              _BREAKER_GAUGE.get(rep.get("state")), lab)
+        gauge("glint_serve_up", rep.get("alive"), lab)
+        gauge("glint_serve_fleet_degraded_replica", rep.get("degraded"), lab)
+        gauge("glint_serve_fleet_in_flight", rep.get("in_flight"), lab)
+        gauge("glint_serve_fleet_restarts_total", rep.get("restarts"), lab)
+        gauge("glint_serve_fleet_reloads_total", rep.get("reloads"), lab)
+        # the replica's own service gauges, relabeled fleet-wide (from the
+        # prober's cached stats op — absent while a replica is down)
+        stats = rep.get("stats") or {}
+        for field in ("submitted", "refused", "completed", "errors",
+                      "batches", "reloads", "models_released"):
+            gauge(f"glint_serve_{field}_total", stats.get(field), lab)
+        for field in ("queue_depth", "occupancy_mean", "vocab_size",
+                      "load_seconds"):
+            gauge(f"glint_serve_{field}", stats.get(field), lab)
+        slat = stats.get("latency_ms") or {}
+        for q in ("p50", "p95", "p99"):
+            if q in slat:
+                gauge("glint_serve_latency_ms", slat[q],
+                      f'{{replica="{name}",quantile="{q}"}}')
+        ann = stats.get("ann") or {}
+        for field in ("recall_at_10", "nprobe", "centroids"):
+            if field in ann:
+                gauge(f"glint_serve_ann_{field}", ann[field], lab)
     return "\n".join(lines) + "\n"
 
 

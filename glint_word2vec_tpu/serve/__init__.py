@@ -15,20 +15,41 @@ Four layers, composable alone or through :class:`EmbeddingService`:
 - :mod:`.service` — the assembled service: batched exact/ANN queries,
   hot-reload, ``serve_*`` telemetry records and ``glint_serve_*``
   Prometheus gauges riding the existing obs layer.
+- :mod:`.fleet` — the failure model ABOVE the service (ISSUE 12): N
+  replicas behind a :class:`FleetRouter` with per-replica health probes
+  and circuit breakers, deadline-budgeted retries, tail-latency hedging,
+  graceful load shedding, and orchestrated rolling reload (capacity
+  never below N-1 across a publish).
 """
 
 from glint_word2vec_tpu.serve.ann import IvfIndex, auto_centroids, auto_nprobe, build_ivf
-from glint_word2vec_tpu.serve.batcher import BatchingScheduler, ServerOverloaded
+from glint_word2vec_tpu.serve.batcher import (
+    BatchingScheduler,
+    ServerOverloaded,
+    ServiceClosed,
+)
+from glint_word2vec_tpu.serve.fleet import (
+    CircuitBreaker,
+    FleetOverloaded,
+    FleetRouter,
+    NoHealthyReplicas,
+    ReplicaSet,
+    fleet_knobs_from_checkpoint,
+)
 from glint_word2vec_tpu.serve.reload import (
     CheckpointWatcher,
     ServingHandle,
+    decorrelated_jitter,
     load_with_retry,
 )
 from glint_word2vec_tpu.serve.service import EmbeddingService
 
 __all__ = [
     "IvfIndex", "build_ivf", "auto_centroids", "auto_nprobe",
-    "BatchingScheduler", "ServerOverloaded",
+    "BatchingScheduler", "ServerOverloaded", "ServiceClosed",
     "CheckpointWatcher", "ServingHandle", "load_with_retry",
+    "decorrelated_jitter",
+    "CircuitBreaker", "FleetOverloaded", "FleetRouter",
+    "NoHealthyReplicas", "ReplicaSet", "fleet_knobs_from_checkpoint",
     "EmbeddingService",
 ]

@@ -42,9 +42,29 @@ logger = logging.getLogger("glint_word2vec_tpu")
 class ServerOverloaded(RuntimeError):
     """Admission refused: the bounded queue is full. The serving analog of
     HTTP 429 — callers should shed or retry with backoff; the server never
-    buffers unboundedly."""
+    buffers unboundedly.
+
+    ``retry_after_s`` is the machine-readable backoff hint (the Retry-After
+    header analog): queued batches ahead × the observed batch service time —
+    how long the present backlog takes to drain at the measured rate. None
+    when the server has not yet completed a batch to measure. Fleet routers
+    honor it as "retry ELSEWHERE now, retry HERE after the hint"
+    (serve/fleet.py)."""
 
     status = 429
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class ServiceClosed(RuntimeError):
+    """Submit refused: the scheduler is stopping or stopped. The typed
+    shutdown refusal — before this class a submit racing ``stop()`` got
+    whatever the dead worker queue produced (a bare RuntimeError at best, a
+    forever-parked ticket at worst). Distinct from :class:`ServerOverloaded`
+    on purpose: overload means "retry later / elsewhere", closed means "this
+    replica is going away — re-resolve, don't retry here"."""
 
 
 class _Ticket:
@@ -71,7 +91,16 @@ class BatchingScheduler:
         max_delay_ms: float = 2.0,
         max_queue: int = 256,
         name: str = "glint-serve-batcher",
+        straggle_every: int = 0,
+        straggle_ms: float = 0.0,
     ):
+        """``straggle_every``/``straggle_ms`` are FAULT INJECTION (the
+        serve-side analog of train/faults.py, off by default): every Nth
+        dispatched batch sleeps ``straggle_ms`` before the handler runs — a
+        deterministic tail-latency straggler. The fleet hedge A/B
+        (tools/servebench.py --fleet) uses it to measure what hedging buys
+        against a replica that stalls 1-in-N dispatches; production never
+        sets it."""
         if max_batch <= 0:
             raise ValueError(f"max_batch must be positive but got {max_batch}")
         if max_delay_ms < 0:
@@ -83,6 +112,8 @@ class BatchingScheduler:
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.max_queue = int(max_queue)
+        self._straggle_every = int(straggle_every)
+        self._straggle_s = float(straggle_ms) / 1000.0
         self._name = name
         self._q: collections.deque = collections.deque()
         self._cv = threading.Condition()
@@ -98,6 +129,10 @@ class BatchingScheduler:
         # recent end-to-end latencies (seconds); deque append is atomic, so
         # submitters record lock-free and stats() snapshots a copy
         self._latencies: collections.deque = collections.deque(maxlen=4096)
+        # EWMA of the handler's per-batch wall time (seconds), updated by
+        # the worker after every dispatch — feeds the ServerOverloaded
+        # retry_after_s hint. None until the first batch completes.
+        self._batch_s_ewma: Optional[float] = None
 
     # -- lifecycle ---------------------------------------------------------------------
 
@@ -126,14 +161,19 @@ class BatchingScheduler:
 
     def submit_async(self, payload: Any) -> _Ticket:
         """Enqueue one request; returns the ticket to :meth:`wait` on.
-        Raises :class:`ServerOverloaded` when the bounded queue is full."""
+        Raises :class:`ServiceClosed` once ``stop()`` has been called (during
+        the drain AND after it) and :class:`ServerOverloaded` (with the
+        ``retry_after_s`` drain-time hint) when the bounded queue is full."""
         with self._cv:
             if self._stopping:
-                raise RuntimeError("scheduler is stopped")
+                raise ServiceClosed(
+                    "scheduler is stopped — admitted requests drain, new "
+                    "submits are refused")
             if len(self._q) >= self.max_queue:
                 self._refused += 1
                 raise ServerOverloaded(
-                    f"admission queue full ({self.max_queue} waiting)")
+                    f"admission queue full ({self.max_queue} waiting)",
+                    retry_after_s=self._retry_after_locked())
             t = _Ticket(payload)
             self._q.append(t)
             self._submitted += 1
@@ -153,6 +193,16 @@ class BatchingScheduler:
     def submit(self, payload: Any, timeout: float = 60.0) -> Any:
         """Blocking submit: enqueue + wait (the one-call client surface)."""
         return self.wait(self.submit_async(payload), timeout)
+
+    def _retry_after_locked(self) -> Optional[float]:
+        """The drain-time estimate behind ``retry_after_s`` (called under
+        ``_cv``): full batches queued ahead × the EWMA batch service time.
+        None before the first completed batch — an honest "no data yet"
+        beats a made-up constant."""
+        if self._batch_s_ewma is None:
+            return None
+        batches_ahead = -(-len(self._q) // self.max_batch)  # ceil
+        return round(max(1, batches_ahead) * self._batch_s_ewma, 4)
 
     # -- worker side -------------------------------------------------------------------
 
@@ -182,6 +232,12 @@ class BatchingScheduler:
             batch = self._collect()
             if batch is None:
                 return
+            if self._straggle_every:
+                with self._cv:
+                    nth = self._batches + 1
+                if nth % self._straggle_every == 0:
+                    time.sleep(self._straggle_s)  # injected straggler
+            t0 = time.monotonic()
             try:
                 results = self._handler([t.payload for t in batch])
                 if len(results) != len(batch):
@@ -190,6 +246,7 @@ class BatchingScheduler:
                         f"batch of {len(batch)}")
             except Exception as e:  # noqa: BLE001 — delivered to each caller
                 with self._cv:
+                    self._note_batch_seconds(time.monotonic() - t0)
                     self._batches += 1
                     self._batched_items += len(batch)
                     self._errors += len(batch)
@@ -205,12 +262,20 @@ class BatchingScheduler:
                 else:
                     t.result = r
             with self._cv:
+                self._note_batch_seconds(time.monotonic() - t0)
                 self._batches += 1
                 self._batched_items += len(batch)
                 self._errors += n_err
                 self._completed += len(batch) - n_err
             for t in batch:
                 t.done.set()
+
+    def _note_batch_seconds(self, dt: float) -> None:
+        """Fold one batch's handler wall time into the EWMA (under _cv).
+        alpha=0.2: ~10 batches of memory — reactive enough that a reload's
+        cold first dispatch doesn't poison the hint for long."""
+        self._batch_s_ewma = (dt if self._batch_s_ewma is None
+                              else 0.8 * self._batch_s_ewma + 0.2 * dt)
 
     # -- observability -----------------------------------------------------------------
 
@@ -229,6 +294,9 @@ class BatchingScheduler:
                 "max_queue": self.max_queue,
                 "occupancy_mean": (round(self._batched_items / self._batches, 3)
                                    if self._batches else None),
+                "batch_service_s": (round(self._batch_s_ewma, 5)
+                                    if self._batch_s_ewma is not None
+                                    else None),
             }
         lats = sorted(self._latencies)
         if lats:

@@ -36,11 +36,35 @@ import threading
 import time
 from typing import Any, Callable, Iterator, Optional, Tuple
 
+import numpy as np
+
 logger = logging.getLogger("glint_word2vec_tpu")
 
 
+def decorrelated_jitter(base: float, cap: float, rng) -> Iterator[float]:
+    """AWS-style decorrelated-jitter backoff delays: each delay is drawn
+    ``uniform(base, 3 × previous)``, capped at ``cap``.
+
+    Why not the old fixed interval: N fleet replicas watching ONE publish
+    path all hit the same swap window at the same poll tick; fixed-interval
+    retry keeps them phase-locked — every retry round lands N simultaneous
+    directory scans + digest reads on the same files (the thundering herd).
+    Decorrelation spreads the rounds apart while keeping the expected delay
+    near the base; the cap bounds the tail so a budgeted retry loop still
+    has a predictable worst case.
+
+    ``rng`` is an explicitly seeded ``np.random.Generator`` (the R2
+    determinism contract — tests pin the exact sequence per seed; production
+    callers seed per process so replicas genuinely decorrelate)."""
+    prev = base
+    while True:
+        prev = min(cap, float(rng.uniform(base, max(base, prev * 3))))
+        yield prev
+
+
 def load_with_retry(path: str, plan=None, attempts: int = 8,
-                    delay: float = 0.25):
+                    delay: float = 0.25, max_delay: float = 2.0,
+                    rng=None):
     """Load a checkpoint, absorbing the trainer's atomic-swap window.
 
     The swap has a sub-second window where the checkpoint path is
@@ -65,9 +89,21 @@ def load_with_retry(path: str, plan=None, attempts: int = 8,
     loader's vocab_size/words-mismatch ValueErrors retried below. A load
     that SUCCEEDED is therefore always one self-consistent publish; the
     V-grew case is driven in the serve-reload and continual-drift chaos
-    phases."""
+    phases.
+
+    Backoff between attempts is DECORRELATED JITTER over
+    ``[delay, max_delay]`` (:func:`decorrelated_jitter`): a fleet of
+    replicas retrying the same publish path must not synchronize into a
+    thundering herd — the pre-fleet fixed interval phase-locked them. Pass
+    a seeded ``rng`` to pin the sequence (tests); the default seeds from
+    the pid + clock so each replica PROCESS draws a different sequence."""
     from glint_word2vec_tpu.models.word2vec import Word2VecModel
     from glint_word2vec_tpu.train.checkpoint import CheckpointCorruptError
+    if rng is None:
+        # seeded Generator (R2): decorrelation across processes is the
+        # point, so the seed folds in process identity + time
+        rng = np.random.default_rng((os.getpid(), time.monotonic_ns()))
+    delays = decorrelated_jitter(delay, max_delay, rng)
     last: Optional[BaseException] = None
     for i in range(attempts):
         try:
@@ -81,7 +117,7 @@ def load_with_retry(path: str, plan=None, attempts: int = 8,
             last = e
         if i == attempts - 1:
             raise last
-        time.sleep(delay)
+        time.sleep(next(delays))
 
 
 def publish_signature(checkpoint_path: str) -> Optional[Tuple[int, int, int]]:

@@ -55,6 +55,13 @@ def _knob(model, name: str, override):
     return getattr(model.config, name)
 
 
+def _sig_str(sig) -> Optional[str]:
+    """Publish signature tuple → the stable string form the fleet router
+    compares across replicas (``mtime_ns-inode-size``); None while unknown
+    (in-memory model, or captured mid-swap)."""
+    return None if sig is None else "-".join(str(x) for x in sig)
+
+
 class EmbeddingService:
     """Batched, ANN-indexed, hot-reloading synonym/vector service."""
 
@@ -74,7 +81,20 @@ class EmbeddingService:
         reload_poll_s: Optional[float] = None,
         telemetry_path: str = "",
         status_port: int = 0,
+        straggle_every: int = 0,
+        straggle_ms: float = 0.0,
+        ann_index=None,
     ):
+        """``straggle_every``/``straggle_ms``: fault injection passed through
+        to the batcher (its docstring has the contract) — the fleet hedge
+        A/B's deterministic tail-latency straggler. Off by default.
+
+        ``ann_index``: a prebuilt :class:`~.ann.IvfIndex` to serve instead
+        of building one at init (``ann=True`` only; ``attach_ann``'s
+        row-count refusal still guards it). For N in-process fleet replicas
+        over one matrix (tools/servebench.py --fleet) the build is paid
+        once, not N times. Checkpoint-watching services ignore it on
+        reload — a reload always rebuilds at the new matrix."""
         # pure argument validation FIRST — nothing acquired yet
         if (checkpoint is None) == (model is None):
             raise ValueError("pass exactly one of checkpoint= or model=")
@@ -87,6 +107,7 @@ class EmbeddingService:
         self._plan = plan
         self._ann_enabled = bool(ann)
         self._ann_seed = int(ann_seed)
+        self._prebuilt_index = ann_index if ann else None
         self._batcher = None
         self._sink = None
         self._statusd = None
@@ -109,6 +130,10 @@ class EmbeddingService:
             index = self._build_index(model)
             self._handle = ServingHandle(model, index)
             self._load_seconds = time.perf_counter() - t0
+            # the publish generation this replica serves (the fleet
+            # router's staleness channel): the signature captured BEFORE
+            # the load that produced the live model
+            self._served_sig = _sig_str(pre_sig)
             self.reloads = 0
             # cross-publish vocab-change tracking (continual training grows
             # V; docs/continual.md): count reloads that changed the size
@@ -120,6 +145,7 @@ class EmbeddingService:
                 max_delay_ms=float(_knob(model, "serve_max_delay_ms",
                                          max_delay_ms)),
                 max_queue=int(_knob(model, "serve_queue_depth", queue_depth)),
+                straggle_every=straggle_every, straggle_ms=straggle_ms,
             ).start()
             if telemetry_path:
                 from glint_word2vec_tpu.obs.sink import TelemetrySink
@@ -156,10 +182,15 @@ class EmbeddingService:
     def _build_index(self, model):
         if not self._ann_enabled:
             return None
-        index = build_ivf(np.asarray(model.syn0),
-                          num_centroids=self._ann_centroids,
-                          nprobe=self._nprobe or 0,
-                          seed=self._ann_seed)
+        if self._prebuilt_index is not None:
+            # one-shot: only the INIT model may use it (attach_ann still
+            # hard-refuses a row-count mismatch); reloads rebuild fresh
+            index, self._prebuilt_index = self._prebuilt_index, None
+        else:
+            index = build_ivf(np.asarray(model.syn0),
+                              num_centroids=self._ann_centroids,
+                              nprobe=self._nprobe or 0,
+                              seed=self._ann_seed)
         model.attach_ann(index)
         return index
 
@@ -174,11 +205,16 @@ class EmbeddingService:
         row-count mismatch as the hard guard), and the count surfaces in
         :meth:`stats` so a fleet dashboard can see growth propagating."""
         t0 = time.perf_counter()
+        # signature BEFORE the load (publish_signature's capture rule): the
+        # generation this reload serves is at LEAST this one — a publish
+        # landing mid-load re-fires the watcher and bumps it again
+        pre_sig = publish_signature(self._checkpoint)
         model = load_with_retry(self._checkpoint, plan=self._plan)
         index = self._build_index(model)
         prev_v = self._served_vocab_size
         vocab_changed = prev_v is not None and model.num_words != prev_v
         self._handle.swap(model, index)
+        self._served_sig = _sig_str(pre_sig)
         self._served_vocab_size = model.num_words
         if vocab_changed:
             self.vocab_change_reloads += 1
@@ -279,6 +315,16 @@ class EmbeddingService:
     def vector(self, word: str, timeout: float = 60.0) -> np.ndarray:
         return self._batcher.submit(("vec", word), timeout)
 
+    # non-blocking surface (the fleet router's hedging primitive: submit to
+    # one replica, wait a p99-derived delay on the ticket's event, then
+    # race a second replica — serve/fleet.py): the returned ticket's
+    # ``done`` is a threading.Event; pass it to :meth:`wait_result`.
+    def synonyms_async(self, query: Query, num: int = 10):
+        return self._batcher.submit_async(("syn", query, num))
+
+    def wait_result(self, ticket, timeout: float = 60.0):
+        return self._batcher.wait(ticket, timeout)
+
     # -- observability -----------------------------------------------------------------
 
     def info(self) -> Dict[str, Any]:
@@ -300,6 +346,10 @@ class EmbeddingService:
         snap["vocab_change_reloads"] = self.vocab_change_reloads
         snap["models_released"] = self._handle.models_released
         snap["load_seconds"] = round(self._load_seconds, 3)
+        # the served publish generation (None for in-memory models): the
+        # fleet health prober compares this against the on-disk signature —
+        # a replica a generation behind its peers is DEGRADED, not dead
+        snap["publish_sig"] = self._served_sig
         with self._handle.lease() as (model, index):
             snap["vocab_size"] = model.num_words
             if index is not None:

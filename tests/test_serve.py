@@ -14,6 +14,8 @@
 """
 
 import json
+import os
+import shutil
 import threading
 import time
 
@@ -30,8 +32,11 @@ from glint_word2vec_tpu.serve import (
     BatchingScheduler,
     EmbeddingService,
     ServerOverloaded,
+    ServiceClosed,
     ServingHandle,
     build_ivf,
+    decorrelated_jitter,
+    load_with_retry,
 )
 
 
@@ -164,6 +169,143 @@ def test_batcher_handler_exception_reaches_every_caller():
         b.stop()
     with pytest.raises(RuntimeError):
         b.submit(2)  # stopped scheduler refuses new work
+
+
+def test_batcher_submit_during_and_after_shutdown_raises_typed():
+    """ISSUE-12 satellite: a submit racing stop() gets the typed
+    ServiceClosed (subclassing RuntimeError for old callers), during the
+    drain AND after it — never whatever the dead worker queue produces."""
+    gate = threading.Event()
+
+    def handler(batch):
+        gate.wait(30)
+        return batch
+
+    b = BatchingScheduler(handler, max_batch=1, max_delay_ms=0.0,
+                          max_queue=8).start()
+    admitted = b.submit_async(1)  # in flight when stop() lands
+    stopper = threading.Thread(target=b.stop)
+    stopper.start()
+    try:
+        deadline = time.monotonic() + 5
+        while not b._stopping and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # submit DURING shutdown (worker still draining the admitted one)
+        with pytest.raises(ServiceClosed):
+            b.submit(2)
+        gate.set()
+        stopper.join(timeout=30)
+        # submit AFTER shutdown
+        with pytest.raises(ServiceClosed):
+            b.submit(3)
+        # the admitted request was still served (drain-and-stop contract)
+        assert b.wait(admitted, timeout=5) == 1
+    finally:
+        gate.set()
+        stopper.join(timeout=5)
+
+
+def test_overload_carries_retry_after_hint():
+    """ISSUE-12 satellite: ServerOverloaded carries retry_after_s = queued
+    batches x the observed (EWMA) batch service time — None before the
+    first batch ever completed (no honest estimate exists yet)."""
+    gate = threading.Event()
+    first_done = threading.Event()
+
+    def handler(batch):
+        if first_done.is_set():
+            gate.wait(30)
+        else:
+            time.sleep(0.05)  # a measured first batch: EWMA ~= 50 ms
+            first_done.set()
+        return batch
+
+    b = BatchingScheduler(handler, max_batch=1, max_delay_ms=0.0,
+                          max_queue=2).start()
+    try:
+        assert b.submit(0) == 0  # establishes the EWMA
+        assert abs(b.stats()["batch_service_s"] - 0.05) < 0.04
+        threads = [threading.Thread(target=lambda: b.submit(1))
+                   for _ in range(3)]  # 1 in handler + 2 filling the queue
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.stats()["queue_depth"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(ServerOverloaded) as ei:
+            b.submit(9)
+        hint = ei.value.retry_after_s
+        assert hint is not None and hint > 0, \
+            "refusal after a measured batch must carry the drain hint"
+        # 2 queued batches x ~50 ms EWMA, loose upper bound for CI noise
+        assert hint < 2.0, f"hint implausibly large: {hint}"
+        gate.set()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_overload_hint_is_none_before_first_batch():
+    gate = threading.Event()
+    b = BatchingScheduler(lambda batch: gate.wait(30) or batch,
+                          max_batch=1, max_delay_ms=0.0, max_queue=1).start()
+    try:
+        t = threading.Thread(target=lambda: b.submit(1))
+        t.start()
+        t2 = threading.Thread(target=lambda: b.submit(2))
+        t2.start()
+        deadline = time.monotonic() + 5
+        while b.stats()["queue_depth"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(ServerOverloaded) as ei:
+            b.submit(3)
+        assert ei.value.retry_after_s is None  # no measured batch yet
+        gate.set()
+        t.join(timeout=30)
+        t2.join(timeout=30)
+    finally:
+        gate.set()
+        b.stop()
+
+
+# -- decorrelated-jitter backoff (ISSUE-12 satellite) ----------------------------------
+
+
+def test_decorrelated_jitter_seeded_sequence():
+    """Same seed -> the exact same delay sequence; different seeds ->
+    decorrelated sequences (the anti-thundering-herd property N fleet
+    replicas retrying one publish path rely on); every delay in
+    [base, cap]."""
+    a_gen = decorrelated_jitter(0.25, 2.0, np.random.default_rng(3))
+    a = [next(a_gen) for _ in range(6)]
+    b_gen = decorrelated_jitter(0.25, 2.0, np.random.default_rng(3))
+    b = [next(b_gen) for _ in range(6)]
+    assert a == b, "seeded jitter must be reproducible"
+    c_gen = decorrelated_jitter(0.25, 2.0, np.random.default_rng(4))
+    c = [next(c_gen) for _ in range(6)]
+    assert a != c, "different seeds must decorrelate"
+    for d in a + c:
+        assert 0.25 <= d <= 2.0
+    assert len(set(a)) > 1, "fixed-interval retry is the bug this removes"
+
+
+def test_load_with_retry_backoff_uses_seeded_jitter(tmp_path, monkeypatch):
+    """The retry loop's sleeps are exactly the decorrelated-jitter
+    sequence of the rng passed in (unit-tested with a seeded RNG, per the
+    ISSUE) — not the old synchronized fixed interval."""
+    slept = []
+    monkeypatch.setattr(
+        "glint_word2vec_tpu.serve.reload.time.sleep", slept.append)
+    with pytest.raises(FileNotFoundError):
+        load_with_retry(str(tmp_path / "never-published"), attempts=5,
+                        delay=0.25, max_delay=2.0,
+                        rng=np.random.default_rng(11))
+    want_gen = decorrelated_jitter(0.25, 2.0, np.random.default_rng(11))
+    want = [next(want_gen) for _ in range(4)]  # attempts-1 sleeps
+    assert slept == want
+    assert len(set(slept)) > 1
 
 
 # -- ANN index -------------------------------------------------------------------------
@@ -387,6 +529,88 @@ def test_watcher_sees_publish_landing_during_boot_load(tmp_path, monkeypatch):
             "publish during the boot load was swallowed"
     finally:
         svc.close()
+
+
+def test_watcher_survives_delete_then_recreate(tmp_path):
+    """ISSUE-12 satellite: the publish path deleted mid-watch (operator
+    mistake, retention sweep) must not crash or wedge the watcher — the
+    ABSENT state is not a signal, the current model keeps serving, and a
+    later re-publish at the same path fires a normal reload."""
+    trainer, vocab, ck, sents = _train_tiny(tmp_path, seed=17)
+    svc = EmbeddingService(checkpoint=ck, ann=False, watch=True,
+                           reload_poll_s=0.05)
+    try:
+        assert len(svc.synonyms("w0", 5)) == 5
+        shutil.rmtree(ck)  # the publish path vanishes mid-watch
+        time.sleep(0.3)  # several polls over the absent path
+        assert len(svc.synonyms("w0", 5)) == 5  # still serving, no crash
+        assert svc.stats()["reloads"] == 0
+        trainer.save_checkpoint(ck)  # recreated: a fresh publish identity
+        deadline = time.monotonic() + 15
+        while svc.stats()["reloads"] < 1 and time.monotonic() < deadline:
+            assert len(svc.synonyms("w0", 5)) == 5
+            time.sleep(0.02)
+        assert svc.stats()["reloads"] >= 1, \
+            "recreated publish path never fired the watcher"
+        assert len(svc.synonyms("w0", 5)) == 5
+    finally:
+        svc.close()
+
+
+def test_watcher_survives_torn_publish_metadata_before_arrays(tmp_path):
+    """ISSUE-12 satellite: metadata.json appearing BEFORE its arrays (the
+    torn-publish window a non-atomic copy/rsync produces) must end in a
+    served model, never a crash — the watcher fires on the metadata
+    identity, load_with_retry absorbs the missing-arrays window, and a
+    failed round leaves the old model serving with the next poll
+    retrying."""
+    trainer, vocab, ck, sents = _train_tiny(tmp_path, seed=19)
+    staging = str(tmp_path / "staged")
+    shutil.copytree(ck, staging)  # a complete publish to tear apart
+    svc = EmbeddingService(checkpoint=ck, ann=False, watch=True,
+                           reload_poll_s=0.05)
+    try:
+        assert len(svc.synonyms("w0", 5)) == 5
+        # the torn window: re-publish metadata/words/counts, arrays ABSENT
+        shutil.rmtree(ck)
+        os.makedirs(ck)
+        for f in ("metadata.json", "words", "counts.npy"):
+            shutil.copy2(os.path.join(staging, f), os.path.join(ck, f))
+        time.sleep(0.4)  # the watcher fires into the torn window
+        assert len(svc.synonyms("w0", 5)) == 5  # old model still serving
+        # the arrays land; the in-flight retry (or the next poll) heals
+        for f in ("syn0.npy", "syn1.npy"):
+            shutil.copy2(os.path.join(staging, f), os.path.join(ck, f))
+        deadline = time.monotonic() + 30
+        while svc.stats()["reloads"] < 1 and time.monotonic() < deadline:
+            assert len(svc.synonyms("w0", 5)) == 5
+            time.sleep(0.02)
+        assert svc.stats()["reloads"] >= 1, \
+            "torn publish never healed into a served model"
+        assert len(svc.synonyms("w0", 5)) == 5
+    finally:
+        svc.close()
+
+
+def test_stats_carry_served_publish_generation(tmp_path):
+    """The fleet staleness channel: stats()['publish_sig'] is the served
+    publish identity — None for in-memory models, refreshed by reload."""
+    trainer, vocab, ck, sents = _train_tiny(tmp_path, seed=23)
+    svc = EmbeddingService(checkpoint=ck, ann=False)
+    try:
+        sig0 = svc.stats()["publish_sig"]
+        assert sig0, "checkpoint-backed service must report its generation"
+        trainer.save_checkpoint(ck)
+        svc.reload_now()
+        sig1 = svc.stats()["publish_sig"]
+        assert sig1 and sig1 != sig0, "reload must advance the generation"
+    finally:
+        svc.close()
+    mem = EmbeddingService(model=make_model(v=50, d=8), ann=False)
+    try:
+        assert mem.stats()["publish_sig"] is None
+    finally:
+        mem.close()
 
 
 def test_failed_init_does_not_leak_threads_or_model():

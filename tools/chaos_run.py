@@ -50,8 +50,15 @@ Every fault is deterministic (train/faults.py) — no sleep/kill-timing races:
    grows V with the fingerprint lineage recorded → a live serve replica
    hot-reloads the grown model, answers a query for a NEW word, and an old
    word's neighbors stay inside its co-occurrence cluster.
-9. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
-   exponential-backoff wrapper in ``data/`` must absorb them.
+9. **fleet-kill** — the serving FLEET under replica death (ISSUE 12,
+   docs/serving.md §5): N replica subprocesses behind a FleetRouter, one
+   SIGKILL'd mid-query-storm → its circuit breaker opens, ZERO client
+   queries fail (retries land on the survivors), the ReplicaSet restarts
+   it, and the breaker recovers through the half-open trial to closed;
+   then a 3-publish rolling-reload storm keeps >= N-1 replicas serving
+   with every reload issued only to a drained replica.
+10. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
+    exponential-backoff wrapper in ``data/`` must absorb them.
 
 Usage::
 
@@ -611,6 +618,28 @@ def phase_continual_drift(workdir: str, n_sentences: int) -> str:
     return ""
 
 
+def phase_fleet_kill(workdir: str, n_sentences: int) -> str:
+    """Serving-fleet chaos (ISSUE 12, docs/serving.md §5): SIGKILL a
+    replica subprocess mid-query-storm — its circuit breaker must open,
+    ZERO client queries may fail (retries land elsewhere), the ReplicaSet
+    must restart it, and the breaker must recover through the half-open
+    trial probe to closed; then a 3-publish rolling-reload storm must keep
+    >= N-1 replicas serving at all times with every reload issued only
+    after that replica's in-flight count drained (lease-drain per
+    replica). Delegates to the fleet driver's drill (tools/fleet_run.py
+    run_smoke — the same assertions CI's fleet job runs standalone)."""
+    from tools.fleet_run import run_smoke
+    try:
+        rep = run_smoke(workdir, n_sentences, replicas=3)
+    except AssertionError as e:
+        return str(e)
+    except Exception as e:  # noqa: BLE001 — any raise is the failure
+        return f"{type(e).__name__}: {e}"
+    if rep.get("failed_queries") != 0:
+        return f"failed queries: {rep}"
+    return ""
+
+
 def phase_flaky_ingest(workdir: str) -> str:
     from glint_word2vec_tpu.data.corpus import encode_corpus
     from glint_word2vec_tpu.data.vocab import build_vocab
@@ -670,6 +699,9 @@ def main() -> int:
         ("continual-drift",
          lambda: phase_continual_drift(os.path.join(workdir, "p7"),
                                        min(n_sentences, 400))),
+        ("fleet-kill",
+         lambda: phase_fleet_kill(os.path.join(workdir, "p8"),
+                                  min(n_sentences, 300))),
         ("flaky-ingest",
          lambda: phase_flaky_ingest(os.path.join(workdir, "p4"))),
     ]
@@ -682,7 +714,7 @@ def main() -> int:
         phases = [(name, fn) for name, fn in phases if name in want]
     failures = 0
     for name, fn in phases:
-        for sub in ("p1", "p2", "p4", "p6"):
+        for sub in ("p1", "p2", "p4", "p6", "p8"):
             os.makedirs(os.path.join(workdir, sub), exist_ok=True)
         err = fn()
         status = "PASS" if not err else f"FAIL: {err}"

@@ -134,6 +134,20 @@ KNOBS = {k.name: k for k in [
     _K("serve_ann_nprobe", (0, 1, 64), invalid=-1, auto=0,
        dispatch_inert=True),
     _K("serve_reload_poll_s", (0.05, 0.5), invalid=0.0, dispatch_inert=True),
+    # --- serving-fleet knobs (serve/fleet.py, docs/serving.md §5): read
+    # only by the fleet router process (FleetRouter / tools/fleet_run.py),
+    # never by trainer construction or dispatch — dispatch-inert by
+    # construction, like the serve_* tier ---
+    _K("serve_fleet_replicas", (1, 3, 8), invalid=0, dispatch_inert=True),
+    _K("serve_fleet_probe_s", (0.05, 0.5), invalid=0.0, dispatch_inert=True),
+    _K("serve_fleet_breaker_failures", (1, 3), invalid=0,
+       dispatch_inert=True),
+    _K("serve_fleet_breaker_reset_s", (0.25, 2.0), invalid=0.0,
+       dispatch_inert=True),
+    _K("serve_fleet_hedge_ms", (-1.0, 0.0, 5.0), invalid=-2.0, auto=-1.0,
+       dispatch_inert=True),
+    _K("serve_fleet_retry_deadline_s", (1.0, 10.0), invalid=0.0,
+       dispatch_inert=True),
     # --- continual-training knobs (continual/, docs/continual.md): read
     # only by the continual driver (ContinualRunner), never by trainer
     # construction or dispatch — dispatch-inert by construction, like the

@@ -68,6 +68,17 @@ class R1ThreadPools:
         # training determinism contract untouched
         ("glint_word2vec_tpu/serve/batcher.py", "BatchingScheduler.start"),
         ("glint_word2vec_tpu/serve/reload.py", "CheckpointWatcher.start"),
+        # the serving FLEET's two documented owners (docs/serving.md §5,
+        # ISSUE 12): each SubprocessReplica runs one stdout READER thread
+        # (pairs wire responses to tickets by id — read-only on
+        # everything, orders nothing), and the router runs ONE
+        # prober/orchestrator thread (health probes, breaker trials,
+        # dead-replica restarts, rolling reloads — read-only on model
+        # params; hedging is ticket-based and spawns NO threads). Neither
+        # produces or orders training data, so the worker-count
+        # determinism contract R1 guards is untouched
+        ("glint_word2vec_tpu/serve/fleet.py", "SubprocessReplica.start"),
+        ("glint_word2vec_tpu/serve/fleet.py", "FleetRouter.__init__"),
     }
 
     def applies(self, path: str) -> bool:
@@ -439,7 +450,7 @@ class R7JsonStdout:
         "tools/collectives.py", "tools/shard_ab.py", "tools/stepaudit.py",
         "tools/telemetry_run.py", "tools/graftcheck/__main__.py",
         "tools/run_report.py", "tools/perfgate.py", "tools/servebench.py",
-        "tools/continual_run.py",
+        "tools/continual_run.py", "tools/fleet_run.py",
     }
 
     def applies(self, path: str) -> bool:

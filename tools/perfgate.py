@@ -83,6 +83,12 @@ SERVE_GATED: Dict[str, float] = {
     "ann_recall_at_10": 0.03,
     # highest offered load with < 1% refusals
     "offered_qps_sustained": 0.30,
+    # --- fleet tier (ISSUE 12, servebench --fleet; gated only once a rung
+    # carries them — r01 predates the fleet). Router-path N=3 ANN capacity,
+    # and the hedge A/B's p99 cut under the injected straggler (off/on
+    # ratio, higher is better; < 1 would mean hedging HURT) ---
+    "fleet3_ann_qps": 0.35,
+    "fleet_hedge_p99_cut": 0.35,
 }
 
 

@@ -23,7 +23,15 @@ the same ops the PS served: pull / multiply+top-k, mllib:514,598):
     {"op": "vector", "word": "berlin"}
     {"op": "reload"}                      # pick up a newer checkpoint at the same path
     {"op": "info"}
-    {"op": "stats"}                       # serving-tier gauges (batcher/ANN/reloads)
+    {"op": "stats"}                       # serving-tier gauges (batcher/ANN/reloads,
+                                          # incl. publish_sig — the served generation)
+
+Any request may carry an ``"id"``: it is echoed verbatim on the response, which is
+what lets the fleet router (serve/fleet.py) pair responses to tickets and discard
+abandoned hedge-loser replies. Error responses are machine-readable:
+``{"error": "...", "error_type": "ServerOverloaded", "retry_after_s": 0.12}`` —
+the type name routes the caller's retry policy and ``retry_after_s`` is the
+admission queue's measured drain-time hint (serve/batcher.py).
 
 Usage:
     python tools/serve_checkpoint.py /path/to/checkpoint [--mesh DATAxMODEL]
@@ -78,7 +86,12 @@ def main():
         nprobe=args.nprobe or None, watch=args.watch,
         telemetry_path=args.telemetry, status_port=args.status_port)
 
-    def out(obj):
+    def out(obj, req=None):
+        # a request carrying an "id" gets it echoed on its response — the
+        # fleet router (serve/fleet.py) pairs responses to tickets by id so
+        # abandoned hedge-loser replies can be discarded safely
+        if req is not None and "id" in req:
+            obj = {**obj, "id": req["id"]}
         sys.stdout.write(json.dumps(obj) + "\n")
         sys.stdout.flush()
 
@@ -90,44 +103,57 @@ def main():
             line = line.strip()
             if not line:
                 continue
+            req = None
             try:
                 req = json.loads(line)
                 op = req["op"]
                 if op == "synonyms":
                     res = service.synonyms(req["word"], int(req.get("num", 10)))
-                    out({"synonyms": [[w, s] for w, s in res]})
+                    out({"synonyms": [[w, s] for w, s in res]}, req)
                 elif op == "synonyms_vec":
                     import numpy as np
                     vec = np.asarray(req["vector"], np.float32)
                     res = service.synonyms(vec, int(req.get("num", 10)))
-                    out({"synonyms": [[w, s] for w, s in res]})
+                    out({"synonyms": [[w, s] for w, s in res]}, req)
                 elif op == "synonyms_batch":
                     # many queries, one device dispatch per coalesced batch —
                     # through a thin link per-query round trips dominate
                     # (PERF.md §6); the batcher owns the coalescing now
                     res = service.synonyms_batch(
                         list(req["words"]), int(req.get("num", 10)))
-                    out({"synonyms": [[[w, s] for w, s in row] for row in res]})
+                    out({"synonyms": [[[w, s] for w, s in row] for row in res]},
+                        req)
                 elif op == "vector":
-                    out({"vector": service.vector(req["word"]).tolist()})
+                    out({"vector": service.vector(req["word"]).tolist()}, req)
                 elif op == "reload":
                     model = service.reload_now()
-                    out({"reloaded": True, "num_words": model.num_words})
+                    out({"reloaded": True, "num_words": model.num_words}, req)
                 elif op == "info":
                     i = service.info()
                     out({"num_words": i["num_words"],
                          "vector_size": i["vector_size"],
                          "iteration": i["iteration"],
-                         "finished": i["finished"]})
+                         "finished": i["finished"]}, req)
                 elif op == "stats":
-                    out(service.stats())
+                    out(service.stats(), req)
                 elif op == "quit":
-                    out({"bye": True})
+                    out({"bye": True}, req)
                     break
                 else:
-                    out({"error": f"unknown op {op!r}"})
+                    out({"error": f"unknown op {op!r}",
+                         "error_type": "ValueError"}, req)
             except Exception as e:  # noqa: BLE001 — protocol errors go to the client
-                out({"error": f"{type(e).__name__}: {e}"})
+                # machine-readable error payload: the type name routes the
+                # caller's policy (ServerOverloaded → retry elsewhere,
+                # KeyError → the caller's own error) and retry_after_s is
+                # the admission queue's drain-time hint (serve/batcher.py)
+                # — pre-ISSUE-12 callers could only blind-retry
+                err = {"error": f"{type(e).__name__}: {e}",
+                       "error_type": type(e).__name__}
+                retry_after = getattr(e, "retry_after_s", None)
+                if retry_after is not None:
+                    err["retry_after_s"] = retry_after
+                out(err, req)
     finally:
         service.close()
 
