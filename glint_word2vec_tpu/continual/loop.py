@@ -107,6 +107,16 @@ class ContinualRunner:
         if self._sink is not None:
             self._sink.emit(kind, **fields)
 
+    def _emit_publish(self, trainer) -> None:
+        """The publish-side correlation record for the increment's final
+        save (obs/trace.emit_publish): keyed by the on-disk publish_sig the
+        serving watcher and fleet router compare, so tools/obs_collect.py
+        joins this increment's publish to every replica's drain+reload."""
+        if self._sink is not None:
+            from glint_word2vec_tpu.obs.trace import emit_publish
+            emit_publish(self._sink, self.checkpoint_path,
+                         int(trainer.global_step), publisher="continual")
+
     def _cache_dir(self) -> str:
         return os.path.join(self.work_dir, "encode-cache")
 
@@ -201,6 +211,7 @@ class ContinualRunner:
                    segments=len(names), vocab_size=vocab.size,
                    new_words=vocab.size, words=int(vocab.train_words_count),
                    train_seconds=report["train_seconds"])
+        self._emit_publish(trainer)
         return report
 
     # -- one cycle ---------------------------------------------------------------------
@@ -312,6 +323,7 @@ class ContinualRunner:
                    segments=len(enc["new"]), vocab_size=vocab.size,
                    new_words=report["new_words"], words=words,
                    train_seconds=train_seconds)
+        self._emit_publish(trainer)
         return {
             "action": "increment",
             "increment": self.increments,

@@ -20,6 +20,18 @@ Seven layers, each usable alone, all off by default and zero-cost when off:
   (``config.status_port``): JSON + Prometheus gauges for a running fit;
   the serving tier reuses it with the ``glint_serve_*`` renderer
   (:func:`.statusd.serve_prometheus_text`, docs/serving.md).
+
+Plus the FLEET plane above them (ISSUE 13, docs/observability.md §9):
+
+- :mod:`.trace` — cross-process trace propagation: one ``trace_id`` per
+  fleet query, span children across the router/replica boundary, the
+  per-process clock anchor, and the publish-side correlation record.
+- :mod:`.slo` — availability/latency objectives with multi-window burn
+  rates over the router's per-query samples (``glint_serve_fleet_slo_*``).
+- :mod:`.collect` — the offline collector: N per-process sinks + blackbox
+  dumps merged into one causally ordered fleet timeline
+  (``tools/obs_collect.py``; Perfetto export + slowest-K exemplars +
+  offline SLO recompute).
 """
 
 from glint_word2vec_tpu.obs.blackbox import FlightRecorder
@@ -33,7 +45,9 @@ from glint_word2vec_tpu.obs.schema import (
     validate_record,
 )
 from glint_word2vec_tpu.obs.sink import TelemetrySink
+from glint_word2vec_tpu.obs.slo import SloObjectives, SloTracker
 from glint_word2vec_tpu.obs.spans import Tracer, default_tracer
+from glint_word2vec_tpu.obs.trace import SpanEmitter, clock_anchor
 from glint_word2vec_tpu.obs.statusd import (
     StatusServer,
     prometheus_text,
@@ -48,4 +62,5 @@ __all__ = [
     "TelemetrySink", "Tracer", "default_tracer", "NormWatchdog",
     "FlightRecorder", "PhaseAccumulator", "StatusServer", "prometheus_text",
     "serve_prometheus_text",
+    "SpanEmitter", "clock_anchor", "SloObjectives", "SloTracker",
 ]

@@ -22,7 +22,7 @@ catalogue — obs/blackbox.py) instead of as JSONL.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 SCHEMA_VERSION = 1
 
@@ -145,6 +145,45 @@ KINDS: Dict[str, Dict[str, tuple]] = {
         "queries": (int,),
         "failures": (int,),
     },
+    # --- fleet-observability record kinds (obs/trace.py, obs/slo.py;
+    # ISSUE 13 — ADDITIVE under the schema evolution rule: brand-new kinds,
+    # no existing field moved, archived v1 logs keep validating) ---
+    # one measured region of one fleet query, in whichever PROCESS measured
+    # it: the router's per-query root + per-attempt children, the replica
+    # batcher's queue_wait/batch_service children, the service's ANN-probe
+    # child. mono_ns is the process's monotonic clock; the collector maps it
+    # to fleet wall time via the clock anchor its file's *_start record
+    # carries (obs/collect.py).
+    "trace_span": {
+        "trace_id": (str,),      # one per client query (root of the tree)
+        "span": (str,),          # this span's id
+        "name": (str,),          # fleet_query | attempt | queue_wait |
+                                 # batch_service | ann_probe | exact_scan
+        "mono_ns": (int,),       # start, process-local monotonic clock
+        "dur_ns": (int,),
+        # optional: "parent" (absent on roots), "process", "replica",
+        # "outcome" (ok|win|abandoned|failed|saturated|shed), "op"
+    },
+    # the publish-side correlation record: the trainer / ContinualRunner
+    # emits one after a completed checkpoint save, keyed by the SAME
+    # publish_sig string the watcher and fleet router compare — save ->
+    # detect -> per-replica drain+reload becomes one collector-joinable
+    # causal chain (obs/trace.emit_publish)
+    "publish": {
+        "publish_sig": (str,),   # mtime_ns-inode-size of metadata.json
+        "checkpoint": (str,),
+        "step": (int,),
+    },
+    # periodic SLO snapshot (obs/slo.py flatten_burn): availability over
+    # the router's per-query samples + multi-window burn rates; null burn =
+    # no budget math possible yet (no samples)
+    "fleet_slo": {
+        "objective": _NUM,       # availability objective (e.g. 0.999)
+        "availability": _NUM,    # measured, tracker lifetime
+        "samples": (int,),
+        "burn_short": _NUM,      # short-window availability burn rate
+        "burn_long": _NUM,
+    },
     # --- continual-training record kinds (continual/loop.py; ADDITIVE under
     # the schema evolution rule, like the serve_* tier: brand-new kinds, no
     # existing field moved — archived v1 logs keep validating) ---
@@ -172,7 +211,17 @@ _COMMON = {"schema": (int,), "kind": (str,), "t": _NUM}
 # logs (CI artifacts, old remote-run JSONLs) must keep validating — making
 # a new field REQUIRED under an unchanged version number would retroactively
 # invalidate every file the previous release wrote.
+#
+# Round 14 (ISSUE 13) adds the CLOCK ANCHORS here: every run_start /
+# serve_start / fleet_start a new writer emits carries one simultaneous
+# (wall_ns, mono_ns) clock reading (obs/trace.clock_anchor) so the
+# collector can align cross-process monotonic timestamps — optional, not
+# required, for exactly the archived-log reason above.
 KINDS_OPTIONAL: Dict[str, Dict[str, tuple]] = {
+    "run_start": {
+        "wall_ns": (int,),       # time.time_ns() at the same instant as...
+        "mono_ns": (int,),       # ...time.monotonic_ns() (the anchor pair)
+    },
     "heartbeat": {
         "norms": (dict,),        # probe channels, when the probe ran
         "recoveries": (int,),    # recoveries performed so far this fit
@@ -188,20 +237,49 @@ KINDS_OPTIONAL: Dict[str, Dict[str, tuple]] = {
     "serve_start": {
         "ann": (dict,),          # IVF build stats (centroids, nprobe,
                                  # recall_at_10, build_seconds)
+        "wall_ns": (int,),       # clock anchor (see run_start)
+        "mono_ns": (int,),
+        "process": (str,),       # fleet-timeline track label
+        "publish_sig": (str,),   # the publish generation first served
     },
     "serve_reload": {
         "ann": (dict,),
         "vocab_grew_from": (int,),  # previous generation's V, present only
                                     # when the publish changed the vocab
                                     # size (continual growth)
+        "publish_sig": (str,),   # the generation this reload installed —
+                                 # joins the trainer's publish record
     },
     "serve_stats": {
         "latency_ms": (dict,),   # p50/p95/p99 over the recent-latency ring
         "occupancy_mean": _NUM,  # mean requests per dispatched batch
         "ann": (dict,),
     },
+    "fleet_start": {
+        "wall_ns": (int,),       # clock anchor (see run_start)
+        "mono_ns": (int,),
+        "process": (str,),
+    },
+    "fleet_reload": {
+        "publish_sig": (str,),   # the generation the rolling round rolled to
+    },
     "fleet_stats": {
         "latency_ms": (dict,),   # router-side end-to-end quantiles
+        "slo": (dict,),          # obs/slo.py flatten_burn snapshot
+    },
+    "trace_span": {
+        "parent": (str,),        # absent on root spans
+        "process": (str,),
+        "replica": (str,),       # attempt spans: which replica answered
+        "outcome": (str,),       # ok|win|abandoned|failed|saturated|shed
+        "op": (str,),
+    },
+    "publish": {
+        "publisher": (str,),     # trainer | continual
+    },
+    "fleet_slo": {
+        "latency_good_fraction": _NUM,
+        "latency_burn_short": _NUM,
     },
 }
 
@@ -330,13 +408,18 @@ def validate_blackbox_file(path: str, max_errors: int = 20) -> Dict[str, Any]:
             "ok": not errors, "errors": errors[:max_errors]}
 
 
-def validate_file(path: str, max_errors: int = 20) -> Dict[str, Any]:
+def validate_file(path: str, max_errors: int = 20,
+                  tolerate_torn_tail: bool = False) -> Dict[str, Any]:
     """Validate every line of a telemetry JSONL file (rotated segments are
     just more files — pass each). Returns a summary dict with per-kind counts
-    and the first ``max_errors`` error strings."""
+    and the first ``max_errors`` error strings. A SIGKILLed process can leave
+    a half-written FINAL line; ``tolerate_torn_tail=True`` reports that one
+    as ``"torn_tail": true`` instead of an error — mid-file garbage still
+    fails either way."""
     counts: Dict[str, int] = {}
     errors: List[str] = []
     n = 0
+    tail_err: Optional[str] = None
     with open(path, "r", encoding="utf-8") as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -346,14 +429,20 @@ def validate_file(path: str, max_errors: int = 20) -> Dict[str, Any]:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError as e:
-                errors.append(f"{path}:{lineno}: not JSON ({e})")
+                tail_err = f"{path}:{lineno}: not JSON ({e})"
+                errors.append(tail_err)
                 continue
+            tail_err = None
             errs = validate_record(rec)
             if errs:
                 errors.extend(f"{path}:{lineno}: {e}" for e in errs)
             else:
                 counts[rec["kind"]] = counts.get(rec["kind"], 0) + 1
-    return {"path": path, "records": n, "kinds": counts,
+    torn = False
+    if tolerate_torn_tail and tail_err is not None:
+        errors.remove(tail_err)
+        torn = True
+    return {"path": path, "records": n, "kinds": counts, "torn_tail": torn,
             "ok": not errors, "errors": errors[:max_errors]}
 
 

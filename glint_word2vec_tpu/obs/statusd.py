@@ -153,6 +153,10 @@ def fleet_prometheus_text(snap: dict) -> str:
         if q in lat:
             gauge("glint_serve_fleet_latency_ms", lat[q],
                   f'{{quantile="{q}"}}')
+    # the SLO gauge block (obs/slo.py owns the names — one renderer, two
+    # surfaces: live scrape here, offline recompute in tools/obs_collect.py)
+    from glint_word2vec_tpu.obs.slo import slo_gauge_lines
+    slo_gauge_lines(gauge, snap.get("slo") or {})
     for name, rep in (snap.get("replicas") or {}).items():
         lab = f'{{replica="{name}"}}'
         gauge("glint_serve_fleet_breaker_state",

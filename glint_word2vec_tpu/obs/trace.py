@@ -1,0 +1,135 @@
+"""Cross-process trace propagation: one causal id per fleet query.
+
+PRs 6/9 built per-process observability (the sink, host spans, the flight
+recorder); PRs 10-12 made the system a FLEET — a router process, N replica
+processes, a trainer/ContinualRunner publishing checkpoints — whose
+telemetry lands in N uncorrelated JSONL files. A hedged query's journey
+(submit → attempt on r0 → hedge to r2 → r2 wins → r0's reply abandoned)
+spans two processes and four spans; nothing tied them together. This module
+is the correlation primitive:
+
+- a **trace context** is two short strings, ``trace_id`` (one per client
+  query, born at ``FleetRouter._request``) and ``parent_span`` (the span id
+  of the enclosing region). It crosses the process boundary as a tiny
+  ``"trace": {"tid": ..., "ps": ...}`` object on the JSON-lines replica
+  protocol (tools/serve_checkpoint.py echoes request ids the same way);
+- a **trace span** is one ``trace_span`` telemetry record in whichever
+  process measured it (obs/schema.py): the router emits the per-query root
+  span and one child span per retry/hedge attempt (labeled with the replica
+  and its ``win``/``abandoned``/``failed`` outcome); the replica's batcher
+  emits ``queue_wait`` and ``batch_service`` children; the service emits the
+  ANN-probe/exact-scan child. ``tools/obs_collect.py`` merges the N files
+  back into one causal timeline using each span's ``mono_ns`` clock and the
+  per-process wall anchors (:func:`clock_anchor`).
+
+Zero-cost when off (the ISSUE-13 acceptance bar, A/B'd by
+``tools/telemetry_run.py --trace-overhead``): a router/service with no
+telemetry sink never calls :func:`new_trace_id` — the hot submit path
+allocates no context object, and requests cross the wire byte-identical to
+the pre-trace protocol. Ids come from a process-scoped counter folded with
+the pid and a boot nonce (no PRNG — the graftlint R2 discipline stays
+untouched: tracing must never touch a sample stream's entropy source).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from typing import Dict, Optional
+
+# process-scoped id source: pid + boot-time nonce + a monotone counter.
+# Collision story: two processes share a prefix only on a pid reuse within
+# the same nanosecond; within a process the counter is unique. itertools
+# .count().__next__ is atomic under the GIL — no lock on the hot path.
+_BOOT_NS = time.time_ns()
+_COUNTER = itertools.count(1)
+_PREFIX = f"{os.getpid():x}-{_BOOT_NS & 0xFFFFFFFF:08x}"
+
+
+def new_trace_id() -> str:
+    """One id per client query (the root of the causal tree)."""
+    return f"t{_PREFIX}-{next(_COUNTER):x}"
+
+
+def new_span_id() -> str:
+    """One id per measured region; unique process-wide."""
+    return f"s{_PREFIX}-{next(_COUNTER):x}"
+
+
+def wire_context(trace_id: str, parent_span: str) -> Dict[str, str]:
+    """The cross-process form: what rides the JSON-lines request as
+    ``"trace"`` and what in-process replicas pass straight through."""
+    return {"tid": trace_id, "ps": parent_span}
+
+
+def clock_anchor() -> Dict[str, int]:
+    """The per-process clock-alignment pair every ``run_start`` /
+    ``serve_start`` / ``fleet_start`` record carries (additive schema
+    fields): one simultaneous reading of the wall clock and the monotonic
+    clock. Spans record ``mono_ns`` (monotonic — immune to NTP steps
+    mid-run); the collector maps a span to fleet wall time as
+    ``anchor.wall_ns + (span.mono_ns - anchor.mono_ns)``, which aligns
+    processes whose wall clocks agree at anchor time and whose monotonic
+    clocks drift independently afterwards (obs/collect.py)."""
+    return {"wall_ns": time.time_ns(), "mono_ns": time.monotonic_ns()}
+
+
+class SpanEmitter:
+    """Binds a telemetry sink + process label into a one-call span writer.
+
+    Every layer that measures spans (router, batcher, service) holds one of
+    these — or ``None`` when telemetry is off, in which case callers skip
+    the whole region-timing block (the zero-cost contract is enforced by
+    "no emitter, no clock read", not by a no-op object on the hot path).
+    Thread-safe by construction: it only calls ``sink.emit`` (locked) and
+    touches no mutable state of its own.
+    """
+
+    __slots__ = ("_sink", "process")
+
+    def __init__(self, sink, process: str):
+        self._sink = sink
+        self.process = process
+
+    def emit(self, trace_id: str, name: str, start_mono_ns: int,
+             dur_ns: int, parent: Optional[str] = None,
+             span_id: Optional[str] = None, **attrs) -> str:
+        """Write one ``trace_span`` record; returns the span id (callers
+        pass it as the ``parent`` of child spans, possibly across the
+        wire). ``attrs`` are the additive labels — ``replica``, ``outcome``,
+        ``op`` — the schema type-checks when present."""
+        sid = span_id or new_span_id()
+        self._sink.emit(
+            "trace_span", trace_id=trace_id, span=sid, name=name,
+            mono_ns=int(start_mono_ns), dur_ns=int(dur_ns),
+            process=self.process,
+            **({"parent": parent} if parent else {}), **attrs)
+        return sid
+
+
+def service_process_name(kind: str = "serve") -> str:
+    """Default process label for span/anchor records (overridable by the
+    CLI): stable within a process, distinguishable across a fleet."""
+    return f"{kind}-{os.getpid()}"
+
+
+def emit_publish(sink, checkpoint_path: str, step: int,
+                 publisher: str = "trainer") -> Optional[str]:
+    """The publish-side correlation record: one ``publish`` telemetry
+    record carrying the freshly-written checkpoint's ``publish_sig`` (the
+    same ``mtime_ns-inode-size`` string the serving tier's watcher and the
+    fleet router compare — serve/reload.publish_signature), so the
+    collector can link trainer/ContinualRunner save → watcher detect →
+    per-replica drain+reload as ONE causal chain keyed by the signature.
+    Returns the signature string (None when the path is mid-swap/absent —
+    nothing is emitted then; the next save re-anchors)."""
+    from glint_word2vec_tpu.serve.reload import (
+        publish_signature, publish_signature_str)
+    sig_str = publish_signature_str(publish_signature(checkpoint_path))
+    if sig_str is None or sink is None:
+        return None
+    sink.emit("publish", publish_sig=sig_str,
+              checkpoint=checkpoint_path, step=int(step),
+              publisher=publisher)
+    return sig_str

@@ -69,16 +69,19 @@ class ServiceClosed(RuntimeError):
 
 class _Ticket:
     """One in-flight request: payload in, result/error out, an event the
-    submitting thread parks on."""
+    submitting thread parks on. ``trace`` is the cross-process trace
+    context (``{"tid": ..., "ps": ...}``, obs/trace.py) when the request is
+    being traced, else None — the default path allocates nothing extra."""
 
-    __slots__ = ("payload", "enqueued", "done", "result", "error")
+    __slots__ = ("payload", "enqueued", "done", "result", "error", "trace")
 
-    def __init__(self, payload: Any):
+    def __init__(self, payload: Any, trace: Optional[dict] = None):
         self.payload = payload
         self.enqueued = time.monotonic()
         self.done = threading.Event()
         self.result: Any = None
         self.error: Optional[BaseException] = None
+        self.trace = trace
 
 
 class BatchingScheduler:
@@ -93,6 +96,8 @@ class BatchingScheduler:
         name: str = "glint-serve-batcher",
         straggle_every: int = 0,
         straggle_ms: float = 0.0,
+        span_emit: Optional[Callable[[dict, str, int, int], None]] = None,
+        batch_observer: Optional[Callable[[int, float, float], None]] = None,
     ):
         """``straggle_every``/``straggle_ms`` are FAULT INJECTION (the
         serve-side analog of train/faults.py, off by default): every Nth
@@ -100,7 +105,22 @@ class BatchingScheduler:
         deterministic tail-latency straggler. The fleet hedge A/B
         (tools/servebench.py --fleet) uses it to measure what hedging buys
         against a replica that stalls 1-in-N dispatches; production never
-        sets it."""
+        sets it.
+
+        ``span_emit(trace, name, start_mono_ns, dur_ns)``: the trace hook
+        (obs/trace.py) the worker calls per TRACED ticket after each batch —
+        a ``queue_wait`` span (submit → batch pop: the admission latency the
+        micro-batching deadline trades) and a ``batch_service`` span (the
+        handler's wall time), both parented to the context the request
+        carried across the wire. Untraced tickets (trace=None — every
+        ticket when tracing is off) never reach the hook: the zero-cost
+        contract is "no trace, no call", not a no-op callee.
+
+        ``batch_observer(batch_size, service_s, queue_wait_s)``: called once
+        per dispatched batch (success or error) — the serving flight
+        recorder's dispatch-ring feed (obs/blackbox.py note_dispatch via
+        EmbeddingService). Both hooks run ON the worker thread; they must
+        not block (the sink's locked append is the intended cost)."""
         if max_batch <= 0:
             raise ValueError(f"max_batch must be positive but got {max_batch}")
         if max_delay_ms < 0:
@@ -114,6 +134,8 @@ class BatchingScheduler:
         self.max_queue = int(max_queue)
         self._straggle_every = int(straggle_every)
         self._straggle_s = float(straggle_ms) / 1000.0
+        self._span_emit = span_emit
+        self._batch_observer = batch_observer
         self._name = name
         self._q: collections.deque = collections.deque()
         self._cv = threading.Condition()
@@ -159,11 +181,14 @@ class BatchingScheduler:
 
     # -- client side -------------------------------------------------------------------
 
-    def submit_async(self, payload: Any) -> _Ticket:
+    def submit_async(self, payload: Any,
+                     trace: Optional[dict] = None) -> _Ticket:
         """Enqueue one request; returns the ticket to :meth:`wait` on.
         Raises :class:`ServiceClosed` once ``stop()`` has been called (during
         the drain AND after it) and :class:`ServerOverloaded` (with the
-        ``retry_after_s`` drain-time hint) when the bounded queue is full."""
+        ``retry_after_s`` drain-time hint) when the bounded queue is full.
+        ``trace`` is the optional cross-process trace context the worker
+        turns into queue_wait/batch_service spans (constructor docstring)."""
         with self._cv:
             if self._stopping:
                 raise ServiceClosed(
@@ -174,7 +199,7 @@ class BatchingScheduler:
                 raise ServerOverloaded(
                     f"admission queue full ({self.max_queue} waiting)",
                     retry_after_s=self._retry_after_locked())
-            t = _Ticket(payload)
+            t = _Ticket(payload, trace)
             self._q.append(t)
             self._submitted += 1
             self._cv.notify_all()
@@ -232,6 +257,7 @@ class BatchingScheduler:
             batch = self._collect()
             if batch is None:
                 return
+            pop = time.monotonic()
             if self._straggle_every:
                 with self._cv:
                     nth = self._batches + 1
@@ -253,6 +279,7 @@ class BatchingScheduler:
                 for t in batch:
                     t.error = e
                     t.done.set()
+                self._after_batch(batch, pop, time.monotonic())
                 continue
             n_err = 0
             for t, r in zip(batch, results):
@@ -269,6 +296,35 @@ class BatchingScheduler:
                 self._completed += len(batch) - n_err
             for t in batch:
                 t.done.set()
+            self._after_batch(batch, pop, time.monotonic())
+
+    def _after_batch(self, batch: List[_Ticket], pop_s: float,
+                     done_s: float) -> None:
+        """Post-batch observability (worker thread, AFTER the callers were
+        released — a slow sink must not sit inside any caller's latency):
+        the per-batch dispatch observer, then queue_wait/batch_service
+        spans for each TRACED ticket. Best-effort like every obs surface —
+        a hook failure must never kill the worker."""
+        if self._batch_observer is None and self._span_emit is None:
+            return
+        try:
+            if self._batch_observer is not None:
+                self._batch_observer(
+                    len(batch), done_s - pop_s,
+                    max(0.0, pop_s - batch[0].enqueued))
+            if self._span_emit is not None:
+                pop_ns = int(pop_s * 1e9)
+                dur_ns = int((done_s - pop_s) * 1e9)
+                for t in batch:
+                    if t.trace is None:
+                        continue
+                    enq_ns = int(t.enqueued * 1e9)
+                    self._span_emit(t.trace, "queue_wait", enq_ns,
+                                    max(0, pop_ns - enq_ns))
+                    self._span_emit(t.trace, "batch_service", pop_ns, dur_ns)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            logger.warning("batcher trace/observer hook failed",
+                           exc_info=True)
 
     def _note_batch_seconds(self, dt: float) -> None:
         """Fold one batch's handler wall time into the EWMA (under _cv).

@@ -76,10 +76,18 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from glint_word2vec_tpu.obs.slo import SloObjectives, SloTracker, flatten_burn
+from glint_word2vec_tpu.obs.trace import (
+    clock_anchor,
+    new_span_id,
+    new_trace_id,
+    wire_context,
+)
 from glint_word2vec_tpu.serve.batcher import ServerOverloaded, ServiceClosed
 from glint_word2vec_tpu.serve.reload import (
     decorrelated_jitter,
     publish_signature,
+    publish_signature_str as _sig_str,
 )
 
 logger = logging.getLogger("glint_word2vec_tpu")
@@ -116,10 +124,6 @@ class _Saturated(Exception):
     def __init__(self, retry_after_s: Optional[float]):
         super().__init__("replica saturated")
         self.retry_after_s = retry_after_s
-
-
-def _sig_str(sig) -> Optional[str]:
-    return None if sig is None else "-".join(str(x) for x in sig)
 
 
 # ---------------------------------------------------------------------------
@@ -256,7 +260,7 @@ class SubprocessReplica:
                  nprobe: Optional[int] = None,
                  python: str = sys.executable,
                  env: Optional[Dict[str, str]] = None,
-                 stderr_path: str = ""):
+                 stderr_path: str = "", telemetry_path: str = ""):
         self.name = name
         self._checkpoint = checkpoint
         self._ann = bool(ann)
@@ -264,6 +268,9 @@ class SubprocessReplica:
         self._python = python
         self._env = env
         self._stderr_path = stderr_path
+        # per-replica sink (ISSUE 13): the replica's serve_*/trace_span
+        # records + its .blackbox.json dump — the collector's inputs
+        self.telemetry_path = telemetry_path
         self._proc: Optional[subprocess.Popen] = None
         self._reader: Optional[threading.Thread] = None
         self._wlock = threading.Lock()
@@ -285,6 +292,9 @@ class SubprocessReplica:
             cmd.append("--ann")
         if self._nprobe:
             cmd += ["--nprobe", str(self._nprobe)]
+        if self.telemetry_path:
+            cmd += ["--telemetry", self.telemetry_path,
+                    "--process-name", self.name]
         env = dict(self._env if self._env is not None else os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         stderr = (open(self._stderr_path, "ab")
@@ -329,6 +339,18 @@ class SubprocessReplica:
             except OSError:
                 pass
             self._proc.wait()
+
+    def terminate(self) -> None:
+        """SIGTERM the child — the GRACEFUL half of the kill surface (the
+        fleet-kill drill's dump leg): a telemetry-on replica writes its
+        ``.blackbox.json`` flight-recorder dump before dying (tools/
+        serve_checkpoint.py's handler), which SIGKILL can never exercise.
+        Does not wait — the prober's dead-process path owns the respawn."""
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                self._proc.terminate()
+            except OSError:
+                pass
 
     def close(self) -> None:
         self.kill()
@@ -435,14 +457,19 @@ class InProcessReplica:
         op = req.get("op")
         try:
             if op == "synonyms":
+                # the trace context rides through exactly like the wire
+                # transport: the adopted service's batcher emits the same
+                # queue_wait/batch_service children a subprocess would
                 bt = self.service.synonyms_async(req["word"],
-                                                 int(req.get("num", 10)))
+                                                 int(req.get("num", 10)),
+                                                 trace=req.get("trace"))
                 t.batcher_ticket = bt
                 t.done = bt.done  # share the batcher event — hedgeable wait
                 return t
             if op == "synonyms_batch":
                 rows = self.service.synonyms_batch(
-                    list(req["words"]), int(req.get("num", 10)))
+                    list(req["words"]), int(req.get("num", 10)),
+                    trace=req.get("trace"))
                 t.resolve({"synonyms": [[[w, float(s)] for w, s in row]
                                         for row in rows]})
             elif op == "stats":
@@ -514,17 +541,27 @@ class ReplicaSet:
     @classmethod
     def spawn(cls, checkpoint: str, n: int, ann: bool = False,
               nprobe: Optional[int] = None, ready_timeout: float = 180.0,
-              stderr_dir: str = "",
+              stderr_dir: str = "", telemetry_dir: str = "",
               env: Optional[Dict[str, str]] = None) -> "ReplicaSet":
+        """``telemetry_dir``: non-empty arms per-replica observability —
+        replica ``i`` writes ``replica-i.jsonl`` (serve records + trace
+        spans, with the clock anchor the collector aligns on) and, on a
+        graceful death, ``replica-i.jsonl.blackbox.json`` there. These are
+        exactly the files ``tools/obs_collect.py`` merges with the router's
+        own sink into the one fleet timeline."""
         if n <= 0:
             raise ValueError(f"replica count must be positive but got {n}")
         reps = []
         for i in range(n):
             stderr_path = (os.path.join(stderr_dir, f"replica-{i}.log")
                            if stderr_dir else "")
+            telemetry_path = (
+                os.path.join(telemetry_dir, f"replica-{i}.jsonl")
+                if telemetry_dir else "")
             reps.append(SubprocessReplica(
                 f"r{i}", checkpoint, ann=ann, nprobe=nprobe, env=env,
-                stderr_path=stderr_path).start())
+                stderr_path=stderr_path,
+                telemetry_path=telemetry_path).start())
         deadline = time.monotonic() + ready_timeout
         for r in reps:
             if not r.wait_ready(max(0.0, deadline - time.monotonic())):
@@ -595,13 +632,32 @@ class FleetRouter:
         saturation_floor_s: float = 0.25,
         drain_timeout_s: float = 15.0,
         reload_timeout_s: float = 300.0,
+        slo: Optional[SloObjectives] = None,
+        trace_sample: int = 1,
     ):
+        """``slo``: the availability/latency objective set (obs/slo.py;
+        default :class:`SloObjectives` — 99.9% availability, p(250ms) ≥
+        99%, 5m/1h windows). Always tracked (one deque append per query);
+        surfaced as ``stats()["slo"]``, the ``glint_serve_fleet_slo_*``
+        gauges, and the periodic ``fleet_slo`` telemetry record. The SLO is
+        a deployment property, deliberately NOT a checkpoint-travelling
+        config knob.
+
+        ``trace_sample``: trace every Nth query when telemetry is on (1 =
+        every query — the drills' setting; production tiers sample because
+        a traced query writes ~5 flushed records across the fleet, which
+        tools/telemetry_run.py --trace-overhead measures as the dominant
+        per-query cost at toy latencies). Untraced queries still feed the
+        SLO tracker and cross the wire byte-identical to tracing-off."""
         if probe_s <= 0:
             raise ValueError(f"probe_s must be positive but got {probe_s}")
         if hedge_ms < 0 and hedge_ms != -1.0:
             raise ValueError(
                 f"hedge_ms must be -1 (auto), 0 (off), or positive "
                 f"but got {hedge_ms}")
+        if trace_sample < 1:
+            raise ValueError(
+                f"trace_sample must be >= 1 but got {trace_sample}")
         self._set = replica_set
         self._checkpoint = checkpoint
         self._probe_s = float(probe_s)
@@ -640,12 +696,23 @@ class FleetRouter:
         self._closed = False
         self._sink = None
         self._statusd = None
+        self._slo = SloTracker(slo)
+        self._trace_sample = int(trace_sample)
+        # trace emitter: exists iff the sink does — `self._span is None` IS
+        # the tracing-off predicate on the hot submit path (no context
+        # object, no id, no clock read; the acceptance bar tools/
+        # telemetry_run.py --trace-overhead A/Bs)
+        self._span = None
+        self.process_name = f"router-{os.getpid()}"
         if telemetry_path:
             from glint_word2vec_tpu.obs.sink import TelemetrySink
+            from glint_word2vec_tpu.obs.trace import SpanEmitter
             self._sink = TelemetrySink(telemetry_path)
+            self._span = SpanEmitter(self._sink, self.process_name)
             self._sink.emit("fleet_start",
                             replicas=len(self._replicas),
-                            checkpoint=checkpoint or "<in-memory>")
+                            checkpoint=checkpoint or "<in-memory>",
+                            process=self.process_name, **clock_anchor())
         if status_port:
             from glint_word2vec_tpu.obs.statusd import (
                 StatusServer, fleet_prometheus_text)
@@ -752,14 +819,41 @@ class FleetRouter:
             snap.sort()
             self._p99_s = snap[min(len(snap) - 1, int(0.99 * len(snap)))]
 
+    def _finish_query(self, trace: Optional[tuple], start_s: float,
+                      op: str, answered: bool, outcome: str) -> None:
+        """Per-query epilogue, EVERY exit path: one SLO sample (answered =
+        the caller got a result — a propagating OOV KeyError is the
+        caller's error, not unavailability) and, when tracing, the
+        ``fleet_query`` root span whose duration is the client-observed
+        latency (the collector's slowest-K exemplar key)."""
+        self._slo.note(answered,
+                       time.monotonic() - start_s if answered else None)
+        if trace is not None:
+            tid, root, root_ns = trace
+            self._span.emit(tid, "fleet_query", root_ns,
+                            time.monotonic_ns() - root_ns, span_id=root,
+                            outcome=outcome, op=op)
+
     def _request(self, req: dict, bulk: bool,
                  deadline_s: Optional[float]) -> Any:
         if self._closed:
             raise ServiceClosed("fleet router is closed")
         with self._lock:
             self.queries += 1
-        deadline = time.monotonic() + (deadline_s if deadline_s is not None
-                                       else self._retry_deadline_s)
+            nth_query = self.queries
+        start_s = time.monotonic()
+        # trace context born HERE (obs/trace.py): one trace per client
+        # query, a root span id its attempt children parent to. Off (no
+        # sink) = None — no ids, no allocation, requests cross the wire
+        # byte-identical (the zero-cost acceptance bar). With a sampled
+        # tracer (trace_sample > 1) the unsampled queries take the same
+        # None path.
+        trace = (None if self._span is None
+                 or nth_query % self._trace_sample
+                 else (new_trace_id(), new_span_id(), time.monotonic_ns()))
+        op = str(req.get("op", "?"))
+        deadline = start_s + (deadline_s if deadline_s is not None
+                              else self._retry_deadline_s)
         # bulk sheds FIRST: refused while ANY healthy replica is saturated
         if bulk:
             now = time.monotonic()
@@ -768,6 +862,7 @@ class FleetRouter:
             if pressured:
                 with self._lock:
                     self.shed_bulk += 1
+                self._finish_query(trace, start_s, op, False, "shed")
                 raise FleetOverloaded(
                     "bulk traffic shed: fleet under pressure "
                     f"({len(pressured)} saturated replica(s))",
@@ -790,6 +885,7 @@ class FleetRouter:
                                     for q in elig_all):
                     with self._lock:
                         self.shed_single += 1
+                    self._finish_query(trace, start_s, op, False, "shed")
                     raise FleetOverloaded(
                         "every healthy replica is saturated",
                         retry_after_s=min(
@@ -808,7 +904,7 @@ class FleetRouter:
                                   max(0.05, deadline - time.monotonic()))
             try:
                 value = self._call(r, req, attempt_timeout,
-                                   hedge=not bulk, tried=tried)
+                                   hedge=not bulk, tried=tried, trace=trace)
             except _Saturated as e:
                 # "retry elsewhere, not here": healthy-but-full is not a
                 # breaker failure; mark and move on with NO backoff. The
@@ -832,25 +928,92 @@ class FleetRouter:
                 if time.monotonic() >= deadline:
                     break
                 continue
+            except Exception:
+                # a CLIENT error (OOV KeyError, bad op) propagating from
+                # _interpret: the fleet ANSWERED — availability is intact
+                self._finish_query(trace, start_s, op, True, "ok")
+                raise
+            self._finish_query(trace, start_s, op, True, "ok")
             return value
         with self._lock:
             self.failures += 1
+        self._finish_query(trace, start_s, op, False, "failed")
         raise NoHealthyReplicas(
             f"no replica answered within the "
             f"{deadline_s if deadline_s is not None else self._retry_deadline_s:g}s "
             f"deadline (last error: {last_err})") from last_err
 
     def _call(self, r: _ReplicaState, req: dict, timeout: float,
-              hedge: bool, tried: set) -> Any:
+              hedge: bool, tried: set,
+              trace: Optional[tuple] = None) -> Any:
         """One attempt, optionally hedged: submit to ``r``; if the
         p99-derived delay passes unresolved, race a second replica —
-        first response wins, the loser is abandoned."""
+        first response wins, the loser is abandoned.
+
+        When ``trace`` is set, every replica this attempt touched gets one
+        ``attempt`` child span under the query's root, labeled with the
+        replica and its outcome: ``ok`` (unhedged success), ``win`` /
+        ``abandoned`` (the hedge race — the loser is ABANDONED, never
+        "failed": a slow-but-healthy replica must not read as a sick one on
+        the timeline), ``failed`` (breaker food), ``saturated`` (healthy
+        but full). The wire request carries each attempt's own span id as
+        the parent for the replica-side children."""
         deadline = time.monotonic() + timeout
-        t1 = r.handle.submit(req)
+        if trace is None:
+            wire1 = req
+            s1 = None
+            a1_ns = 0
+        else:
+            tid, root, _ = trace
+            s1 = new_span_id()
+            wire1 = {**req, "trace": wire_context(tid, s1)}
+            a1_ns = time.monotonic_ns()
+        try:
+            t1 = r.handle.submit(wire1)
+        except ReplicaError:
+            # dead at submit (the SIGKILL drill's first symptom): the
+            # attempt still gets its failed child span — a trace whose
+            # failed attempt is invisible can't tell the incident's story
+            if trace is not None:
+                self._span.emit(tid, "attempt", a1_ns,
+                                time.monotonic_ns() - a1_ns, parent=root,
+                                span_id=s1, replica=r.name,
+                                outcome="failed")
+            raise
         with self._lock:
             r.in_flight += 1
         r2: Optional[_ReplicaState] = None
         t2: Optional[FleetTicket] = None
+        s2: Optional[str] = None
+        a2_ns = 0
+        race_died: list = []  # replicas dropped dead mid-hedge-race
+
+        def attempt_spans(err: Optional[BaseException],
+                          outcome: str, winner=None) -> None:
+            """Emit the attempt children. Success: ``winner`` answered (the
+            other side, if any, was abandoned — unless it DIED mid-race:
+            its breaker recorded a failure, so the timeline says ``failed``
+            too). Failure: the BLAMED replica (the one the error is
+            attributed to) carries ``outcome``, the other side was
+            abandoned mid-race."""
+            if trace is None:
+                return
+            now = time.monotonic_ns()
+            blamed = winner if winner is not None else getattr(
+                err, "replica", None)
+            for rep, sid, start in ((r, s1, a1_ns), (r2, s2, a2_ns)):
+                if rep is None or sid is None:
+                    continue
+                if rep in race_died:
+                    oc = "failed"
+                elif blamed is None or rep is blamed:
+                    oc = outcome
+                else:
+                    oc = "abandoned"
+                self._span.emit(tid, "attempt", start, now - start,
+                                parent=root, span_id=sid, replica=rep.name,
+                                outcome=oc)
+
         try:
             hedge_delay = self._hedge_delay_s() if hedge else None
             if hedge_delay is not None and hedge_delay < timeout:
@@ -858,9 +1021,25 @@ class FleetRouter:
                     r2 = self._pick(exclude=tried | {r})
                     if r2 is not None:
                         try:
-                            t2 = r2.handle.submit(req)
+                            if trace is None:
+                                wire2 = req
+                            else:
+                                s2 = new_span_id()
+                                wire2 = {**req,
+                                         "trace": wire_context(tid, s2)}
+                                a2_ns = time.monotonic_ns()
+                            t2 = r2.handle.submit(wire2)
                         except ReplicaError:
-                            r2 = None
+                            # dead at submit: the timeline must still show
+                            # the hedge touched this replica (the mirror of
+                            # the primary's dead-at-submit span above)
+                            if trace is not None and s2 is not None:
+                                self._span.emit(
+                                    tid, "attempt", a2_ns,
+                                    time.monotonic_ns() - a2_ns,
+                                    parent=root, span_id=s2,
+                                    replica=r2.name, outcome="failed")
+                            r2, s2 = None, None
                         else:
                             with self._lock:
                                 self.hedges += 1
@@ -870,7 +1049,7 @@ class FleetRouter:
                     t1, max(0.0, deadline - time.monotonic()))
             else:
                 src, resp = self._wait_either(
-                    (r, t1), (r2, t2), deadline)
+                    (r, t1), (r2, t2), deadline, died=race_died)
                 if src is r2:
                     with self._lock:
                         self.hedge_wins += 1
@@ -886,7 +1065,20 @@ class FleetRouter:
             src.breaker.record_success()
             self._note_latency(timeout - max(0.0,
                                              deadline - time.monotonic()))
+            attempt_spans(None, "win" if t2 is not None else "ok",
+                          winner=src)
             return value
+        except _Saturated as e:
+            attempt_spans(e, "saturated")
+            raise
+        except (ReplicaError, TimeoutError) as e:
+            attempt_spans(e, "failed")
+            raise
+        except Exception as e:
+            # client-level error: the blamed replica ANSWERED — its attempt
+            # is "ok" on the timeline, the raise is the caller's business
+            attempt_spans(e, "ok")
+            raise
         finally:
             with self._lock:
                 r.in_flight -= 1
@@ -897,14 +1089,17 @@ class FleetRouter:
                 r2.handle.abandon(t2)
 
     @staticmethod
-    def _wait_either(a, b, deadline: float):
+    def _wait_either(a, b, deadline: float, died: Optional[list] = None):
         """First-wins over two (replica, ticket) pairs. Polls at 1 ms —
         only ever runs inside the hedge window (past p99), so the poll
         granularity is noise relative to the tail it is cutting. A side
         whose ticket resolves as a transport death (ReplicaError) is
         dropped and the OTHER side keeps being waited — a dead hedge
         target must not fail an attempt the primary can still win; the
-        raised error carries ``.replica`` for breaker attribution."""
+        raised error carries ``.replica`` for breaker attribution.
+        ``died`` (when given) collects the dropped replicas so the
+        caller's trace labels them ``failed``, not ``abandoned`` — the
+        breaker recorded a failure, the timeline must agree."""
         pairs = [list(a), list(b)]
         while True:
             for pair in list(pairs):
@@ -920,6 +1115,8 @@ class FleetRouter:
                         # dropped side: no exception will propagate for
                         # it, so its breaker is fed here
                         rx.breaker.record_failure(str(e))
+                        if died is not None:
+                            died.append(rx)
             if time.monotonic() >= deadline:
                 raise TimeoutError("hedged attempt timed out on both replicas")
             time.sleep(0.001)
@@ -1064,6 +1261,10 @@ class FleetRouter:
                             publishes=self.reload_rounds,
                             min_serving=min_serving,
                             replicas=len(self._replicas),
+                            # the generation rolled to: joins the
+                            # publisher's `publish` record and each
+                            # replica's serve_reload on the fleet timeline
+                            publish_sig=target,
                             seconds=round(time.monotonic() - t0, 3))
         logger.info("rolling reload round %d: %d replicas, min serving %d, "
                     "%.2fs", self.reload_rounds, len(self._replicas),
@@ -1118,6 +1319,7 @@ class FleetRouter:
         snap["replicas"] = replicas
         snap["healthy"] = healthy
         snap["degraded"] = degraded
+        snap["slo"] = self._slo.snapshot()
         with self._lock:  # same mutation-during-sort hazard as _note_latency
             lats = list(self._latencies)
         lats.sort()
@@ -1134,19 +1336,31 @@ class FleetRouter:
         snap["status"] = "closed" if self._closed else "serving"
         return snap
 
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The live SLO gauge set (obs/slo.py) — what the chaos drills
+        assert and ``fleet_prometheus_text`` renders."""
+        return self._slo.snapshot()
+
+    def slo_within_budget(self) -> bool:
+        return self._slo.within_budget()
+
     def emit_stats(self) -> None:
         if self._sink is None:
             return
         s = self.stats()
+        # the snapshot is always populated (a samples=0 record before any
+        # traffic is "no traffic burned no budget", worth the line)
+        slo = flatten_burn(s["slo"])
         self._sink.emit(
             "fleet_stats",
             queries=s["queries"], failures=s["failures"],
             retries=s["retries"], hedges=s["hedges"],
             hedge_wins=s["hedge_wins"],
             shed=s["shed_single"] + s["shed_bulk"],
-            healthy=s["healthy"], degraded=s["degraded"],
+            healthy=s["healthy"], degraded=s["degraded"], slo=slo,
             **({"latency_ms": s["latency_ms"]}
                if s.get("latency_ms") else {}))
+        self._sink.emit("fleet_slo", **slo)
 
     def close(self, close_replicas: bool = True) -> None:
         if self._closed:
@@ -1159,6 +1373,9 @@ class FleetRouter:
         if self._sink is not None:
             with self._lock:
                 q, f = self.queries, self.failures
+            # the terminal SLO snapshot BEFORE the end bracket: a collector
+            # reading only this file still gets the storm's final burn
+            self._sink.emit("fleet_slo", **flatten_burn(self._slo.snapshot()))
             self._sink.emit("fleet_end", queries=q, failures=f)
             self._sink.close()
         if close_replicas:

@@ -134,6 +134,18 @@ def publish_signature(checkpoint_path: str) -> Optional[Tuple[int, int, int]]:
     return (st.st_mtime_ns, st.st_ino, st.st_size)
 
 
+def publish_signature_str(sig: Optional[Tuple[int, int, int]]
+                          ) -> Optional[str]:
+    """The signature's stable wire/telemetry form (``mtime_ns-inode-size``),
+    or None while unknown (in-memory model, or captured mid-swap). ONE
+    owner: the replica protocol's ``stats`` reply, the router's staleness
+    compare, the trainer's ``publish`` record, and every ``publish_sig``
+    telemetry field all format through here — the collector joins publish
+    chains by string equality, so a second formatter would silently break
+    the join."""
+    return None if sig is None else "-".join(str(x) for x in sig)
+
+
 class _Slot:
     """One (model, index) generation plus its lease count. ``refs`` starts
     at 1 — the handle's own reference; ``swap`` drops it."""

@@ -39,6 +39,7 @@ from glint_word2vec_tpu.serve.reload import (
     ServingHandle,
     load_with_retry,
     publish_signature,
+    publish_signature_str as _sig_str,
 )
 
 logger = logging.getLogger("glint_word2vec_tpu")
@@ -53,13 +54,6 @@ def _knob(model, name: str, override):
     if override is not None:
         return override
     return getattr(model.config, name)
-
-
-def _sig_str(sig) -> Optional[str]:
-    """Publish signature tuple → the stable string form the fleet router
-    compares across replicas (``mtime_ns-inode-size``); None while unknown
-    (in-memory model, or captured mid-swap)."""
-    return None if sig is None else "-".join(str(x) for x in sig)
 
 
 class EmbeddingService:
@@ -84,6 +78,7 @@ class EmbeddingService:
         straggle_every: int = 0,
         straggle_ms: float = 0.0,
         ann_index=None,
+        process_name: str = "",
     ):
         """``straggle_every``/``straggle_ms``: fault injection passed through
         to the batcher (its docstring has the contract) — the fleet hedge
@@ -94,7 +89,12 @@ class EmbeddingService:
         row-count refusal still guards it). For N in-process fleet replicas
         over one matrix (tools/servebench.py --fleet) the build is paid
         once, not N times. Checkpoint-watching services ignore it on
-        reload — a reload always rebuilds at the new matrix."""
+        reload — a reload always rebuilds at the new matrix.
+
+        ``process_name``: the fleet-timeline track label stamped on this
+        service's clock anchor, trace spans, and blackbox dump (default
+        ``serve-<pid>``; the fleet spawner passes the replica name so the
+        collector's tracks read r0/r1/... instead of pids)."""
         # pure argument validation FIRST — nothing acquired yet
         if (checkpoint is None) == (model is None):
             raise ValueError("pass exactly one of checkpoint= or model=")
@@ -114,6 +114,9 @@ class EmbeddingService:
         self._watcher = None
         self._handle = None
         self._closed = False
+        self._blackbox = None
+        self._span_emitter = None
+        self._dispatch_count = 0
         t0 = time.perf_counter()
         # signature BEFORE the load: a publish landing during the slow
         # load/index build below must still read as unserved afterwards
@@ -139,6 +142,34 @@ class EmbeddingService:
             # V; docs/continual.md): count reloads that changed the size
             self.vocab_change_reloads = 0
             self._served_vocab_size = model.num_words
+            if telemetry_path:
+                # sink + trace emitter + flight recorder BEFORE the batcher:
+                # the worker thread's span/observer hooks must find them
+                # armed from the very first dispatched batch
+                from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+                from glint_word2vec_tpu.obs.sink import TelemetrySink
+                from glint_word2vec_tpu.obs.trace import (
+                    SpanEmitter, clock_anchor, service_process_name)
+                self.process_name = (process_name
+                                     or service_process_name("serve"))
+                self._sink = TelemetrySink(telemetry_path)
+                self._span_emitter = SpanEmitter(self._sink,
+                                                 self.process_name)
+                # the serving flight recorder (ISSUE-13 satellite): before
+                # this, a dying replica left NO dump — the fleet-kill
+                # drill's SIGTERM leg now finds `<telemetry>.blackbox.json`
+                # with a serve-scoped cause + the recent serve records
+                self._blackbox = FlightRecorder(
+                    f"{telemetry_path}.blackbox.json")
+                self._blackbox.begin_run(self.process_name)
+                self._emit("serve_start",
+                           checkpoint=checkpoint or "<in-memory>",
+                           vocab_size=model.num_words,
+                           vector_size=model.vector_size,
+                           **clock_anchor(), process=self.process_name,
+                           **({"publish_sig": self._served_sig}
+                              if self._served_sig else {}),
+                           **({"ann": index.stats} if index else {}))
             self._batcher = BatchingScheduler(
                 self._dispatch,
                 max_batch=int(_knob(model, "serve_max_batch", max_batch)),
@@ -146,15 +177,11 @@ class EmbeddingService:
                                          max_delay_ms)),
                 max_queue=int(_knob(model, "serve_queue_depth", queue_depth)),
                 straggle_every=straggle_every, straggle_ms=straggle_ms,
+                span_emit=(self._batch_span if self._span_emitter is not None
+                           else None),
+                batch_observer=(self._note_batch
+                                if self._blackbox is not None else None),
             ).start()
-            if telemetry_path:
-                from glint_word2vec_tpu.obs.sink import TelemetrySink
-                self._sink = TelemetrySink(telemetry_path)
-                self._sink.emit("serve_start",
-                                checkpoint=checkpoint or "<in-memory>",
-                                vocab_size=model.num_words,
-                                vector_size=model.vector_size,
-                                **({"ann": index.stats} if index else {}))
             if status_port:
                 from glint_word2vec_tpu.obs.statusd import (
                     StatusServer, serve_prometheus_text)
@@ -176,6 +203,60 @@ class EmbeddingService:
                     model.stop()
             self.close()
             raise
+
+    # -- obs plumbing ------------------------------------------------------------------
+
+    def _emit(self, kind: str, **fields) -> None:
+        """One serving telemetry record to the sink AND the flight
+        recorder's ring — the same single-owner rule as Trainer._emit, so
+        the blackbox dump's entries are byte-for-byte the records the JSONL
+        carries (obs/blackbox.py)."""
+        if self._sink is not None:
+            self._sink.emit(kind, **fields)
+        if self._blackbox is not None:
+            self._blackbox.observe(kind, fields)
+
+    def _batch_span(self, trace: dict, name: str, start_ns: int,
+                    dur_ns: int) -> None:
+        """The batcher's span hook: queue_wait/batch_service children of the
+        trace context the request carried across the wire."""
+        self._span_emitter.emit(trace["tid"], name, start_ns, dur_ns,
+                                parent=trace.get("ps"))
+
+    def _note_batch(self, batch_size: int, service_s: float,
+                    wait_s: float) -> None:
+        """The batcher's per-dispatch observer: feeds the flight recorder's
+        dispatch ring (the finest-grained trace of what the replica was
+        doing right before death — the serving analog of the trainer's
+        per-dispatch records; worker thread only, so the counter is safe)."""
+        self._dispatch_count += 1
+        self._blackbox.note_dispatch(self._dispatch_count, batch_size,
+                                     service_s, wait_s)
+
+    def dump_blackbox(self, cause: Optional[dict] = None,
+                      include_stats: bool = True) -> Optional[str]:
+        """Write the serving flight-recorder dump (telemetry on only; None
+        otherwise/on failure). ``cause`` is a FlightRecorder cause record —
+        the serve_checkpoint.py SIGTERM handler and its fatal-exception
+        unwind both land here; first cause wins per process, and the dump
+        carries an at-death stats snapshot when the service can still take
+        one (best-effort: forensics must never mask the original failure).
+
+        ``include_stats=False`` is REQUIRED from a signal handler: the
+        stats snapshot acquires the batcher's non-reentrant condition lock,
+        which the interrupted main thread may be holding inside
+        submit_async — every lock on a handler's dump path must be
+        reentrant (the obs/blackbox.py rule), and that one is not. The
+        rings alone (fed lock-free relative to _cv) are the forensics."""
+        if self._blackbox is None:
+            return None
+        extra = {}
+        if include_stats:
+            try:
+                extra["serve"] = self.stats()
+            except Exception:  # noqa: BLE001 — a wedged service still dumps
+                pass
+        return self._blackbox.dump(cause=cause, extra=extra)
 
     # -- index / reload ----------------------------------------------------------------
 
@@ -225,13 +306,17 @@ class EmbeddingService:
         self.reloads += 1
         self._load_seconds = time.perf_counter() - t0
         if self._sink is not None:
-            self._sink.emit("serve_reload",
-                            vocab_size=model.num_words,
-                            reloads=self.reloads,
-                            load_seconds=round(self._load_seconds, 3),
-                            **({"vocab_grew_from": prev_v}
-                               if vocab_changed else {}),
-                            **({"ann": index.stats} if index else {}))
+            self._emit("serve_reload",
+                       vocab_size=model.num_words,
+                       reloads=self.reloads,
+                       load_seconds=round(self._load_seconds, 3),
+                       # the generation this reload installed: joins the
+                       # publisher's `publish` record on the fleet timeline
+                       **({"publish_sig": self._served_sig}
+                          if self._served_sig else {}),
+                       **({"vocab_grew_from": prev_v}
+                          if vocab_changed else {}),
+                       **({"ann": index.stats} if index else {}))
         logger.info("hot-reload %d: %d words in %.2fs (in-flight batches "
                     "finished on the old model)", self.reloads,
                     model.num_words, self._load_seconds)
@@ -258,16 +343,24 @@ class EmbeddingService:
     def _dispatch(self, payloads: List[Tuple]) -> List[Any]:
         """One coalesced batch under ONE lease: every request in the batch
         is answered by the same model generation, and a swap landing
-        mid-batch waits for the lease to drain before the old buffers go."""
+        mid-batch waits for the lease to drain before the old buffers go.
+
+        A ``syn`` payload may carry a 4th element — the cross-process trace
+        context (obs/trace.py) — in which case the scan's wall time is
+        emitted as an ``ann_probe``/``exact_scan`` child span for each
+        traced request (siblings of the batcher's batch_service span under
+        the same wire parent; the duration is the BATCH's scan — per-query
+        attribution below one device dispatch does not exist by design)."""
         with self._handle.lease() as (model, index):
             results: List[Any] = [None] * len(payloads)
             syn_pos: List[int] = []
             syn_q: List[Query] = []
             syn_num: List[int] = []
+            syn_trace: List[Optional[dict]] = []
             for i, p in enumerate(payloads):
                 op = p[0]
                 if op == "syn":
-                    _, q, num = p
+                    q, num = p[1], p[2]
                     if isinstance(q, str) and model.vocab.get(q) < 0:
                         # per-request failure: an OOV word fails ITS caller,
                         # never the batch (the batcher re-raises it there)
@@ -276,6 +369,7 @@ class EmbeddingService:
                     syn_pos.append(i)
                     syn_q.append(q)
                     syn_num.append(int(num))
+                    syn_trace.append(p[3] if len(p) > 3 else None)
                 elif op == "vec":
                     try:
                         results[i] = model.transform(p[1])
@@ -286,6 +380,9 @@ class EmbeddingService:
             if syn_pos:
                 kmax = max(syn_num)
                 use_ann = self._ann_enabled and index is not None
+                traced = (self._span_emitter is not None
+                          and any(t is not None for t in syn_trace))
+                t0_ns = time.monotonic_ns() if traced else 0
                 try:
                     rows = model.find_synonyms_batch(
                         syn_q, kmax, ann=use_ann, nprobe=self._nprobe)
@@ -295,21 +392,42 @@ class EmbeddingService:
                 else:
                     for i, res, num in zip(syn_pos, rows, syn_num):
                         results[i] = res[:num]
+                if traced:
+                    dur_ns = time.monotonic_ns() - t0_ns
+                    name = "ann_probe" if use_ann else "exact_scan"
+                    for tr in syn_trace:
+                        if tr is not None:
+                            self._span_emitter.emit(
+                                tr["tid"], name, t0_ns, dur_ns,
+                                parent=tr.get("ps"))
             return results
 
     # -- client surface ----------------------------------------------------------------
 
     def synonyms(self, query: Query, num: int = 10,
-                 timeout: float = 60.0) -> List[Tuple[str, float]]:
-        return self._batcher.submit(("syn", query, num), timeout)
+                 timeout: float = 60.0,
+                 trace: Optional[dict] = None) -> List[Tuple[str, float]]:
+        """``trace``: the cross-process trace context a fleet router bore at
+        submit (``{"tid", "ps"}``, obs/trace.py) — None (the default, and
+        the only value when telemetry is off) keeps the payload tuple and
+        the submit path byte-identical to the untraced protocol."""
+        return self._batcher.submit(
+            ("syn", query, num) if trace is None
+            else ("syn", query, num, trace), timeout)
 
     def synonyms_batch(self, queries: Sequence[Query], num: int = 10,
-                       timeout: float = 60.0
+                       timeout: float = 60.0,
+                       trace: Optional[dict] = None
                        ) -> List[List[Tuple[str, float]]]:
         """Submit many queries at once — they coalesce into device-batch-
-        sized dispatches with any other in-flight traffic."""
-        tickets = [self._batcher.submit_async(("syn", q, num))
-                   for q in queries]
+        sized dispatches with any other in-flight traffic. A traced wire
+        batch attributes its spans to the FIRST query only (one
+        representative span set per wire request, not num_queries copies)."""
+        tickets = [self._batcher.submit_async(
+            ("syn", q, num) if (trace is None or i)
+            else ("syn", q, num, trace),
+            trace=trace if i == 0 else None)
+            for i, q in enumerate(queries)]
         return [self._batcher.wait(t, timeout) for t in tickets]
 
     def vector(self, word: str, timeout: float = 60.0) -> np.ndarray:
@@ -319,8 +437,11 @@ class EmbeddingService:
     # one replica, wait a p99-derived delay on the ticket's event, then
     # race a second replica — serve/fleet.py): the returned ticket's
     # ``done`` is a threading.Event; pass it to :meth:`wait_result`.
-    def synonyms_async(self, query: Query, num: int = 10):
-        return self._batcher.submit_async(("syn", query, num))
+    def synonyms_async(self, query: Query, num: int = 10,
+                       trace: Optional[dict] = None):
+        return self._batcher.submit_async(
+            ("syn", query, num) if trace is None
+            else ("syn", query, num, trace), trace=trace)
 
     def wait_result(self, ticket, timeout: float = 60.0):
         return self._batcher.wait(ticket, timeout)
@@ -367,7 +488,7 @@ class EmbeddingService:
         if self._sink is None:
             return
         s = self.stats()
-        self._sink.emit(
+        self._emit(
             "serve_stats",
             submitted=s["submitted"], refused=s["refused"],
             batches=s["batches"], queue_depth=s["queue_depth"],
@@ -391,8 +512,8 @@ class EmbeddingService:
         if self._sink is not None:
             if self._batcher is not None:
                 s = self._batcher.stats()
-                self._sink.emit("serve_end", submitted=s["submitted"],
-                                refused=s["refused"], reloads=self.reloads)
+                self._emit("serve_end", submitted=s["submitted"],
+                           refused=s["refused"], reloads=self.reloads)
             self._sink.close()
         if self._handle is not None:
             if self._owns_model:

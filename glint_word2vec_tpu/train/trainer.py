@@ -2432,10 +2432,15 @@ class Trainer:
             self._statusd = StatusServer(
                 self.config.status_port, self.status_snapshot).start()
         if self._telemetry is not None:
+            from glint_word2vec_tpu.obs.trace import clock_anchor
             self._tracer.clear()
             cfg = self.config
             self._emit(
                 "run_start", run_id=self._run_id, vocab_size=self.vocab.size,
+                # the clock anchor (obs/trace.py): one simultaneous
+                # wall/monotonic reading so tools/obs_collect.py can place
+                # this process's spans on the fleet timeline
+                **clock_anchor(),
                 mesh=[self.plan.num_data, self.plan.num_model],
                 config={k: getattr(cfg, k) for k in (
                     "vector_size", "learning_rate", "pairs_per_batch",
@@ -3376,3 +3381,16 @@ class Trainer:
                 np.asarray(p.syn0), np.asarray(p.syn1),
                 self.config, self.state, extra_metadata=extra)
         logger.info("checkpoint saved to %s at step %d", path, self.global_step)
+        if self._telemetry is not None or self._blackbox is not None:
+            # the publish-side correlation record (obs/trace.py): carries
+            # the freshly-written checkpoint's publish_sig — the SAME
+            # string the serving watcher and fleet router compare — so the
+            # collector joins save → watcher detect → per-replica reload
+            # into one causal chain. Through _emit, not the sink directly,
+            # so the flight recorder's event ring mirrors it.
+            from glint_word2vec_tpu.serve.reload import (
+                publish_signature, publish_signature_str)
+            sig = publish_signature_str(publish_signature(path))
+            if sig is not None:
+                self._emit("publish", publish_sig=sig, checkpoint=path,
+                           step=int(self.global_step), publisher="trainer")

@@ -45,7 +45,10 @@ def log(msg: str) -> None:
 
 def _train_checkpoint(workdir: str, n_sentences: int, seed: int = 4):
     """A tiny trained checkpoint for the drill (the serve-reload chaos
-    phase's corpus shape: 30 words, structure enough to answer top-5)."""
+    phase's corpus shape: 30 words, structure enough to answer top-5).
+    Trainer telemetry is ON: its sink carries the run_start clock anchor
+    and — crucially for the drill's collector leg — one ``publish`` record
+    per checkpoint save, the trainer half of every publish chain."""
     import numpy as np
 
     from glint_word2vec_tpu.config import Word2VecConfig
@@ -59,7 +62,8 @@ def _train_checkpoint(workdir: str, n_sentences: int, seed: int = 4):
     cfg = Word2VecConfig(
         vector_size=8, pairs_per_batch=128, window=3, num_iterations=1,
         steps_per_dispatch=2, heartbeat_every_steps=4, subsample_ratio=0.0,
-        prefetch_chunks=0, seed=1, min_count=1)
+        prefetch_chunks=0, seed=1, min_count=1,
+        telemetry_path=os.path.join(workdir, "trainer.jsonl"))
     vocab = build_vocab(sents, min_count=1)
     trainer = Trainer(cfg, vocab)
     trainer.fit(encode_sentences(sents, vocab, cfg.max_sentence_length))
@@ -78,19 +82,30 @@ def run_smoke(workdir: str, n_sentences: int = 300,
     import numpy as np
 
     from glint_word2vec_tpu.obs.schema import validate_file
+    from glint_word2vec_tpu.obs.slo import SloObjectives
     from glint_word2vec_tpu.serve.fleet import (
         CircuitBreaker, FleetRouter, ReplicaSet)
 
     ck, trainer, vocab, sents = _train_checkpoint(workdir, n_sentences)
     log(f"[fleet] checkpoint ready: V={vocab.size}")
     telemetry = os.path.join(workdir, "fleet.jsonl")
-    rs = ReplicaSet.spawn(ck, replicas, stderr_dir=workdir)
+    # telemetry_dir arms the full observability plane per replica: sink +
+    # trace spans + flight recorder — the artifact set the collector leg
+    # below merges into the one incident timeline (ISSUE 13)
+    rs = ReplicaSet.spawn(ck, replicas, stderr_dir=workdir,
+                          telemetry_dir=workdir)
     log(f"[fleet] {replicas} replicas ready "
         f"(pids {[r.pid for r in rs.replicas]})")
+    # drill-scoped SLO (obs/slo.py: same math as production, seconds-scale
+    # windows + a container-tolerant latency bound — a 2-core CI host under
+    # a 3-thread storm is not the 250 ms production tier)
+    slo_objectives = SloObjectives(
+        availability=0.999, latency_ms=2000.0, latency_target=0.99,
+        short_window_s=30.0, long_window_s=300.0)
     router = FleetRouter(
         rs, checkpoint=ck, probe_s=0.1, breaker_failures=2,
         breaker_reset_s=0.5, retry_deadline_s=60.0, attempt_timeout_s=5.0,
-        telemetry_path=telemetry)
+        telemetry_path=telemetry, slo=slo_objectives)
 
     query_errs: list = []
     queries = [0]
@@ -196,11 +211,35 @@ def run_smoke(workdir: str, n_sentences: int = 300,
         assert not query_errs, \
             f"{len(query_errs)} failed queries across the reload storm " \
             f"(first: {query_errs[0]})"
+
+        # --- 4. the graceful kill: SIGTERM leaves a flight-recorder dump ---
+        # SIGKILL (leg 1) can never exercise the dump path — this is the
+        # half the serving flight recorder exists for (obs/blackbox.py via
+        # EmbeddingService.dump_blackbox + serve_checkpoint.py's handler)
+        victim2 = rs.replicas[1]
+        dump_path = f"{victim2.telemetry_path}.blackbox.json"
+        log(f"[fleet] SIGTERM replica {victim2.name} (pid {victim2.pid})")
+        victim2.terminate()
+        deadline = time.monotonic() + 30
+        while not os.path.exists(dump_path) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert os.path.exists(dump_path), \
+            f"SIGTERM'd replica left no flight-recorder dump at {dump_path}"
+        # let the prober respawn it so close() tears down a whole fleet
+        deadline = time.monotonic() + 60
+        while not victim2.alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert victim2.alive(), "SIGTERM'd replica was never respawned"
+        assert not query_errs, \
+            f"{len(query_errs)} failed queries across the graceful kill " \
+            f"(first: {query_errs[0]})"
     finally:
         storm_on.clear()
         for c in clients:
             c.join()
         stats = router.stats()
+        slo = router.slo_snapshot()
+        slo_ok = router.slo_within_budget()
         router.close()
     assert not query_errs, f"failed queries: {query_errs[0]}"
     assert stats["failures"] == 0, \
@@ -218,6 +257,65 @@ def run_smoke(workdir: str, n_sentences: int = 300,
     assert kinds.get("fleet_breaker", 0) >= 2, \
         f"breaker transitions missing from telemetry ({kinds})"
     assert kinds.get("fleet_reload", 0) >= publishes
+    assert kinds.get("trace_span", 0) >= queries[0], \
+        (f"router emitted {kinds.get('trace_span', 0)} spans for "
+         f"{queries[0]} queries — trace propagation is off")
+    assert kinds.get("fleet_slo", 0) >= 1, "no fleet_slo record"
+
+    # --- 5. the SLO verdict: "zero failed queries" as a MEASURED objective
+    assert slo["samples"] >= queries[0] - 3 * replicas, \
+        f"SLO tracker missed queries ({slo['samples']}/{queries[0]})"
+    assert slo_ok, f"SLO burn over budget across the storm: {slo}"
+
+    # --- 6. the collector leg (ISSUE 13 acceptance): merge EVERY artifact
+    # the drill left — router sink, N replica sinks, the trainer's sink,
+    # the SIGTERM dump — and reconstruct the incident end-to-end
+    from glint_word2vec_tpu.obs.collect import collect
+    timeline, merged = collect([workdir], objectives=slo_objectives)
+    assert len(merged["processes"]) >= replicas + 2, \
+        (f"collector saw only {merged['processes']} — expected router + "
+         f"{replicas} replicas + trainer")
+    # a retried query's trace: the failed attempt on the SIGKILLed replica
+    # AND the success elsewhere, under ONE trace id
+    retried = [
+        t for t in timeline["traces"].values()
+        if any(s.get("name") == "attempt" and s.get("outcome") == "failed"
+               and s.get("replica") == victim.name for s in t["spans"])
+        and any(s.get("name") == "attempt"
+                and s.get("outcome") in ("ok", "win")
+                and s.get("replica") != victim.name for s in t["spans"])]
+    assert retried, \
+        "no merged trace shows failed-attempt-on-victim + success-elsewhere"
+    # replica-side children crossed the wire: some trace carries spans from
+    # BOTH the router process and a replica process
+    cross = [t for t in timeline["traces"].values()
+             if len({s["_process"] for s in t["spans"]}) >= 2]
+    assert cross, "no trace carries spans from more than one process"
+    # breaker transitions appear on the merged timeline
+    merged_breakers = [e for e in timeline["events"]
+                       if e["kind"] == "fleet_breaker"]
+    bstates = [(e.get("from_state"), e.get("to_state"))
+               for e in merged_breakers]
+    assert ("closed", "open") in bstates and \
+        ("half-open", "closed") in bstates, \
+        f"breaker story incomplete on the merged timeline: {bstates}"
+    # the publish chain: the trainer's publish record joined to fleet
+    # rolling-reload rounds by publish_sig
+    chained = [sig for sig, evs in timeline["publish_chains"].items()
+               if {"publish"} & {e["kind"] for e in evs}
+               and {"fleet_reload", "serve_reload"} & {e["kind"]
+                                                      for e in evs}]
+    assert chained, \
+        f"no publish_sig joins trainer save to a reload " \
+        f"({list(timeline['publish_chains'])})"
+    # the SIGTERM dump was ingested with its signal cause
+    assert any(b["cause"].get("kind") == "signal"
+               for b in timeline["blackboxes"]), \
+        f"no signal-cause blackbox in {merged['blackboxes']}"
+    # offline SLO recompute (same burn math as the live gauge) in budget
+    assert merged["slo"]["within_budget"], \
+        f"offline SLO burn over budget: {merged['slo']}"
+
     victim_stats = stats["replicas"]["r0"]
     return {
         "ok": True,
@@ -232,6 +330,17 @@ def run_smoke(workdir: str, n_sentences: int = 300,
         "reload_rounds": stats["reload_rounds"],
         "min_serving_during_reloads": stats["min_serving_during_reloads"],
         "telemetry_kinds": kinds,
+        "slo": {k: slo[k] for k in ("samples", "availability",
+                                    "budget_remaining")},
+        "collector": {
+            "processes": merged["processes"],
+            "traces": merged["traces"],
+            "spans": merged["spans"],
+            "attempt_outcomes": merged["attempt_outcomes"],
+            "retried_traces": len(retried),
+            "publish_chains": len(chained),
+            "slo_within_budget": merged["slo"]["within_budget"],
+        },
     }
 
 
@@ -266,6 +375,7 @@ def main() -> int:
     # every path (graftlint R7)
     if args.smoke:
         workdir = args.workdir or tempfile.mkdtemp(prefix="glint_fleet_")
+        os.makedirs(workdir, exist_ok=True)
         try:
             out, rc = run_smoke(workdir, args.sentences,
                                 args.smoke_replicas), 0

@@ -451,6 +451,7 @@ class R7JsonStdout:
         "tools/telemetry_run.py", "tools/graftcheck/__main__.py",
         "tools/run_report.py", "tools/perfgate.py", "tools/servebench.py",
         "tools/continual_run.py", "tools/fleet_run.py",
+        "tools/obs_collect.py",
     }
 
     def applies(self, path: str) -> bool:

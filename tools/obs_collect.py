@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Fleet observability collector CLI (docs/observability.md §9).
+
+Merges N per-process telemetry artifacts — the router's sink, each
+replica's sink (rotated segments included), trainer/ContinualRunner sinks,
+and any ``.blackbox.json`` flight-recorder dumps — into ONE causally
+ordered fleet timeline (obs/collect.py): cross-process clock alignment via
+the wall/monotonic anchors every ``*_start`` record carries, trace
+reassembly by ``trace_id``, publish chains by ``publish_sig``, and an
+offline recompute of the availability/latency SLO burn (obs/slo.py — the
+same math the live router's ``glint_serve_fleet_slo_*`` gauges use).
+
+Outputs under ``--out``: ``timeline.perfetto.json`` (load in
+https://ui.perfetto.dev or chrome://tracing) and ``fleet-summary.json``
+(the same object as stdout, indented). Prints exactly ONE JSON line on
+stdout (graftlint R7); progress goes to stderr.
+
+Usage::
+
+    python tools/obs_collect.py ARTIFACT [ARTIFACT ...]
+        [--out DIR] [--slowest K] [--gate]
+        [--slo-availability 0.999] [--slo-latency-ms 250]
+        [--slo-latency-target 0.99]
+        [--slo-window-short 300] [--slo-window-long 3600]
+
+``ARTIFACT`` is a telemetry JSONL, a ``.blackbox.json`` dump, or a
+directory to scan for both. ``--gate`` makes the exit code the incident
+verdict: nonzero when any file fails schema validation, no records were
+found, or any SLO burn window exceeds 1.0 — the CI fleet job runs the
+fleet-kill drill's artifacts through exactly this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("artifacts", nargs="+",
+                    help="telemetry JSONLs, .blackbox.json dumps, or "
+                         "directories holding them")
+    ap.add_argument("--out", default="",
+                    help="write timeline.perfetto.json + fleet-summary.json "
+                         "here (default: no files, summary on stdout only)")
+    ap.add_argument("--slowest", type=int, default=5,
+                    help="how many slowest-query exemplar traces to keep")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit nonzero on schema errors, zero records, or "
+                         "any SLO burn window > 1.0 (the CI verdict mode)")
+    ap.add_argument("--slo-availability", type=float, default=0.999)
+    ap.add_argument("--slo-latency-ms", type=float, default=250.0)
+    ap.add_argument("--slo-latency-target", type=float, default=0.99)
+    ap.add_argument("--slo-window-short", type=float, default=300.0)
+    ap.add_argument("--slo-window-long", type=float, default=3600.0)
+    args = ap.parse_args()
+
+    from glint_word2vec_tpu.obs.collect import (
+        collect, export_perfetto, scan_artifacts)
+    from glint_word2vec_tpu.obs.schema import (
+        validate_blackbox_file, validate_file)
+    from glint_word2vec_tpu.obs.slo import SloObjectives
+
+    files = scan_artifacts(args.artifacts)
+    log(f"[collect] {len(files)} artifact file(s)")
+
+    # the schema validator IS part of the verdict: a merged timeline built
+    # from drifted records would lie with confidence. A half-written FINAL
+    # line is the one exception — that's what a SIGKILL mid-flush leaves,
+    # the same torn tail the merge itself tolerates
+    schema_errors: list = []
+    torn_tails = 0
+    for f in files:
+        v = (validate_blackbox_file(f) if f.endswith(".blackbox.json")
+             else validate_file(f, tolerate_torn_tail=True))
+        torn_tails += int(bool(v.get("torn_tail")))
+        if not v["ok"]:
+            schema_errors.extend(v["errors"][:3])
+
+    objectives = SloObjectives(
+        availability=args.slo_availability,
+        latency_ms=args.slo_latency_ms,
+        latency_target=args.slo_latency_target,
+        short_window_s=args.slo_window_short,
+        long_window_s=args.slo_window_long)
+    timeline, summary = collect(files, objectives, slowest=args.slowest)
+    summary["schema_valid"] = not schema_errors
+    summary["schema_errors"] = schema_errors[:5]
+    summary["torn_tails"] = torn_tails
+    summary["files"] = len(files)
+
+    gated = bool(schema_errors) or summary["records"] == 0 or not (
+        summary["slo"].get("within_budget", True))
+    summary["ok"] = not gated
+
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        perfetto = os.path.join(args.out, "timeline.perfetto.json")
+        n = export_perfetto(timeline, perfetto)
+        summary["perfetto"] = perfetto
+        summary["perfetto_events"] = n
+        with open(os.path.join(args.out, "fleet-summary.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(summary, f, indent=1)
+        log(f"[collect] wrote {perfetto} ({n} events)")
+    print(json.dumps(summary, allow_nan=False))
+    if args.gate:
+        return 1 if gated else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,13 +17,22 @@ and the norm-channel trajectory (first/last/max of syn0+syn1 max_norm).
 
 Usage::
 
+    # one run log (rotated segments are more positional paths)
     python tools/run_report.py run.jsonl [run.jsonl.1 ...]
         [--blackbox run.jsonl.blackbox.json]
         [--eval-runs EVAL_RUNS.jsonl] [--eval-last N]
 
-Exit code 0 iff the log parsed and (when the run ended) ended "ok";
-a truncated log (no run_end) reports ``"status": "truncated"`` and exits 1
-— a remote driver can alarm on exactly that.
+    # a FLEET run: N per-process sinks (router + replicas + trainer),
+    # one --log each — reports per-process status plus the merged rollup
+    python tools/run_report.py --log fleet.jsonl --log replica-0.jsonl \
+        --log replica-1.jsonl --log trainer.jsonl
+
+Exit code 0 iff every log parsed and (when the run ended) ended "ok"; a
+truncated log (no run_end / serve_end / fleet_end bracket) reports
+``"status": "truncated"`` and exits 1 — a remote driver can alarm on
+exactly that. In ``--log`` mode each log's ``<log>.blackbox.json`` dump is
+folded in automatically when present (a dump next to a truncated serving
+log is the expected SIGTERM shape, not an error).
 """
 
 from __future__ import annotations
@@ -73,7 +82,8 @@ def _merge_phase_windows(windows: List[dict]) -> dict:
 
 
 def summarize(paths: List[str], blackbox: str = "",
-              eval_runs: str = "", eval_last: int = 1) -> dict:
+              eval_runs: str = "", eval_last: int = 1,
+              tolerate_torn_tail: bool = False) -> dict:
     from glint_word2vec_tpu.obs.schema import (
         validate_blackbox_file, validate_file)
     kinds: dict = {}
@@ -85,7 +95,7 @@ def summarize(paths: List[str], blackbox: str = "",
     schema_ok = True
     schema_errors: List[str] = []
     for path in paths:
-        v = validate_file(path)
+        v = validate_file(path, tolerate_torn_tail=tolerate_torn_tail)
         schema_ok = schema_ok and v["ok"]
         schema_errors.extend(v["errors"][:5])
         with open(path, "r", encoding="utf-8") as f:
@@ -101,9 +111,13 @@ def summarize(paths: List[str], blackbox: str = "",
                 kinds[kind] = kinds.get(kind, 0) + 1
                 if kind == "heartbeat":
                     heartbeats.append(r)
-                elif kind == "run_start":
+                # bracket-aware across tiers: a fleet run's sinks are
+                # serve_*/fleet_* logs — their end bracket is what "the
+                # process exited cleanly" means there (serve_end /
+                # fleet_end carry no status field; presence IS "ok")
+                elif kind in ("run_start", "serve_start", "fleet_start"):
                     run_start = r
-                elif kind == "run_end":
+                elif kind in ("run_end", "serve_end", "fleet_end"):
                     run_end = r
                 elif kind == "watchdog":
                     watchdog += 1
@@ -112,7 +126,7 @@ def summarize(paths: List[str], blackbox: str = "",
 
     pps = sorted(float(h["pairs_per_sec"]) for h in heartbeats
                  if h.get("pairs_per_sec"))
-    status = run_end["status"] if run_end else "truncated"
+    status = (run_end.get("status", "ok") if run_end else "truncated")
     phases = (run_end or {}).get("phases")
     if not phases:
         phases = _merge_phase_windows(
@@ -188,10 +202,74 @@ def summarize(paths: List[str], blackbox: str = "",
     return report
 
 
+def summarize_fleet(logs: List[str]) -> dict:
+    """Per-process reports + the merged rollup for a fleet run's N sinks
+    (one ``--log`` per process). Each log's ``<log>.blackbox.json`` folds
+    in automatically when present; the merged status is "ok" only when
+    EVERY process's is."""
+    processes = {}
+    for path in logs:
+        name = os.path.splitext(os.path.basename(path))[0]
+        if name in processes:
+            # two hosts' sinks may share a basename (nodeA/serve.jsonl,
+            # nodeB/serve.jsonl) — a silent overwrite could mask a failing
+            # log behind a healthy same-named twin
+            name = path
+        bb = path + ".blackbox.json"
+        # fleet teardown is SIGKILL — a half-written final sink line is the
+        # expected torn tail, not schema corruption
+        rep = summarize([path],
+                        blackbox=bb if os.path.exists(bb) else "",
+                        tolerate_torn_tail=True)
+        # a process that died WITH a dump told its story — the alarm is a
+        # truncated log with no forensics at all
+        rep["dumped"] = "blackbox" in rep
+        processes[name] = rep
+    merged_kinds: dict = {}
+    for rep in processes.values():
+        for k, n in rep["kinds"].items():
+            merged_kinds[k] = merged_kinds.get(k, 0) + n
+    # fleet verdict: every sink parsed schema-valid with records, and no
+    # process that DID write its end bracket ended "error". "truncated" is
+    # not gated: replicas exit by teardown SIGKILL (ReplicaSet.close), so a
+    # missing end bracket is a serving log's normal shape
+
+    def _proc_ok(r: dict) -> bool:
+        return (r["schema_valid"] and sum(r["kinds"].values()) > 0
+                and r["status"] != "error")
+
+    return {
+        "ok": all(_proc_ok(r) for r in processes.values()),
+        "mode": "fleet",
+        "processes": {n: {
+            "ok": _proc_ok(r),
+            "status": r["status"], "records": sum(
+                r["kinds"].values()), "schema_valid": r["schema_valid"],
+            "dumped": r["dumped"],
+            **({"cause": r["blackbox"].get("cause", {}).get("kind")}
+               if r.get("blackbox") else {}),
+        } for n, r in processes.items()},
+        "merged": {
+            "logs": len(processes),
+            "statuses": sorted({r["status"]
+                                for r in processes.values()}),
+            "schema_valid": all(r["schema_valid"]
+                                for r in processes.values()),
+            "kinds": merged_kinds,
+            "dumps": sum(1 for r in processes.values() if r["dumped"]),
+        },
+        "detail": processes,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("paths", nargs="+",
-                    help="sink JSONL file(s), oldest rotated segment first")
+    ap.add_argument("paths", nargs="*",
+                    help="sink JSONL file(s), oldest rotated segment first "
+                         "(ONE run's segments — use --log for fleet runs)")
+    ap.add_argument("--log", action="append", default=[],
+                    help="one per-process sink of a FLEET run; repeatable — "
+                         "reports per-process + merged status")
     ap.add_argument("--blackbox", default="",
                     help="also validate + fold in a .blackbox.json dump")
     ap.add_argument("--eval-runs", default="",
@@ -199,8 +277,15 @@ def main() -> int:
     ap.add_argument("--eval-last", type=int, default=1,
                     help="how many trailing EVAL_RUNS rows to include")
     args = ap.parse_args()
-    report = summarize(args.paths, blackbox=args.blackbox,
-                       eval_runs=args.eval_runs, eval_last=args.eval_last)
+    if bool(args.paths) == bool(args.log):
+        ap.error("pass either positional segment paths (one run) or "
+                 "--log per process (a fleet run), not both/neither")
+    if args.log:
+        report = summarize_fleet(args.log)
+    else:
+        report = summarize(args.paths, blackbox=args.blackbox,
+                           eval_runs=args.eval_runs,
+                           eval_last=args.eval_last)
     print(json.dumps(report, allow_nan=False))
     return 0 if report["ok"] else 1
 

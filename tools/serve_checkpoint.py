@@ -70,7 +70,13 @@ def main():
                          "(/status.json, /metrics, /healthz)")
     ap.add_argument("--telemetry", default="",
                     help="non-empty: write serve_* telemetry records to this "
-                         "JSONL path (obs/sink.py)")
+                         "JSONL path (obs/sink.py); also arms the serving "
+                         "flight recorder (<path>.blackbox.json on death) "
+                         "and cross-process trace spans (obs/trace.py)")
+    ap.add_argument("--process-name", default="",
+                    help="fleet-timeline track label for this replica's "
+                         "telemetry (default serve-<pid>; the fleet spawner "
+                         "passes r0/r1/...)")
     args = ap.parse_args()
 
     from glint_word2vec_tpu.parallel.mesh import make_mesh
@@ -84,7 +90,34 @@ def main():
     service = EmbeddingService(
         checkpoint=args.checkpoint, plan=plan, ann=args.ann,
         nprobe=args.nprobe or None, watch=args.watch,
-        telemetry_path=args.telemetry, status_port=args.status_port)
+        telemetry_path=args.telemetry, status_port=args.status_port,
+        process_name=args.process_name)
+
+    if args.telemetry:
+        # the serving flight recorder's signal trigger (ISSUE-13 satellite;
+        # same contract as trainer._install_run_signals): SIGTERM — the
+        # graceful half of a kill, the half SIGKILL can't exercise — dumps
+        # <telemetry>.blackbox.json with a serve-scoped signal cause, then
+        # restores the prior disposition and re-raises so exit semantics
+        # (rc -15, the fleet prober's dead-process detection) are untouched
+        import signal
+
+        from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+
+        prev_handler = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            # include_stats=False: the handler may have interrupted the
+            # main thread INSIDE the batcher's non-reentrant _cv block —
+            # a stats snapshot here would deadlock the dump
+            service.dump_blackbox(FlightRecorder.signal_cause(signum),
+                                  include_stats=False)
+            signal.signal(signal.SIGTERM,
+                          prev_handler if callable(prev_handler)
+                          else signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
 
     def out(obj, req=None):
         # a request carrying an "id" gets it echoed on its response — the
@@ -107,8 +140,16 @@ def main():
             try:
                 req = json.loads(line)
                 op = req["op"]
+                # cross-process trace context (obs/trace.py): a request
+                # carrying {"trace": {"tid", "ps"}} gets its queue-wait /
+                # batch-service / ANN-scan spans emitted into THIS replica's
+                # sink under the router's trace id — the collector joins
+                # them back into one causal timeline. Absent (tracing off),
+                # nothing is allocated and the payloads are byte-identical.
+                trace = req.get("trace")
                 if op == "synonyms":
-                    res = service.synonyms(req["word"], int(req.get("num", 10)))
+                    res = service.synonyms(req["word"], int(req.get("num", 10)),
+                                           trace=trace)
                     out({"synonyms": [[w, s] for w, s in res]}, req)
                 elif op == "synonyms_vec":
                     import numpy as np
@@ -120,7 +161,8 @@ def main():
                     # through a thin link per-query round trips dominate
                     # (PERF.md §6); the batcher owns the coalescing now
                     res = service.synonyms_batch(
-                        list(req["words"]), int(req.get("num", 10)))
+                        list(req["words"]), int(req.get("num", 10)),
+                        trace=trace)
                     out({"synonyms": [[[w, s] for w, s in row] for row in res]},
                         req)
                 elif op == "vector":
@@ -154,6 +196,12 @@ def main():
                 if retry_after is not None:
                     err["retry_after_s"] = retry_after
                 out(err, req)
+    except BaseException as e:
+        # a fatal serve-loop error (not a per-request one — those were
+        # answered above) leaves the same dump a dying trainer does
+        from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+        service.dump_blackbox(FlightRecorder.exception_cause(e))
+        raise
     finally:
         service.close()
 
