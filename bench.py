@@ -70,6 +70,13 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# the ONE dtype short-label map: "bfloat16"[:4] truncation drifted into the
+# r01-r05 "bflo" label typo ("e2e_feed": "device_bflo"); every label and JSON
+# key goes through here so it cannot drift again. Perfgate gates only the
+# numeric fields, so the archived rungs stay comparable.
+_SHORT_DTYPE = {"float32": "f32", "bfloat16": "bf16"}
+
+
 def zipf_counts(v: int) -> np.ndarray:
     return np.maximum(1e9 / (np.arange(v) + 10.0) ** 1.07, 5.0)
 
@@ -130,14 +137,15 @@ def eval_stable(rows: list, batch: int, pool: int, param_dtype: str,
 
 def bench_step(counts, b: int, pool: int, dtype: str = "float32",
                param_dtype: str = "float32", logits_dtype: str = "float32",
-               v: int = V, label_extra: str = "") -> tuple:
+               v: int = V, label_extra: str = "", fused: bool = False,
+               chain: bool = False, hot_rows: int = 0) -> tuple:
     import jax
     import jax.numpy as jnp
     from microbench import time_chunked
 
     from glint_word2vec_tpu.ops.sampler import build_alias_table, sample_negatives_hash
     from glint_word2vec_tpu.ops.sgns import (
-        EmbeddingPair, init_embeddings, sgns_step_shared_core)
+        EmbeddingPair, hot_flush, init_embeddings, sgns_step_shared_core)
 
     table = build_alias_table(counts)
     prob, alias = table.prob, table.alias
@@ -151,6 +159,28 @@ def bench_step(counts, b: int, pool: int, dtype: str = "float32",
     def chunk(params, batches, base_step, prob, alias):
         negs = sample_negatives_hash(prob, alias, 1234, base_step, (K, pool))
 
+        if hot_rows:
+            # the trainer's hot-row chunk shape (trainer._run_hot_scan at the
+            # AUTO cadence): slabs carried through the scan, ONE dense prefix
+            # flush at chunk end
+            slabs = (jnp.zeros((hot_rows, PAD_D), jnp.float32),
+                     jnp.zeros((hot_rows, PAD_D), jnp.float32))
+
+            def body_hot(carry, inp):
+                p, s = carry
+                batch, ng = inp
+                new_p, m, s = sgns_step_shared_core(
+                    p, batch["centers"], batch["contexts"], batch["mask"],
+                    ng, jnp.float32(0.025), NEG, "exact", cdt, False, ldt,
+                    with_metrics=False, fused=fused, bf16_chain=chain,
+                    hot_slabs=s)
+                return (new_p, s), m.loss
+
+            (p, (s0, s1)), losses = jax.lax.scan(
+                body_hot, (params, slabs), (batches, negs))
+            p = EmbeddingPair(hot_flush(p.syn0, s0), hot_flush(p.syn1, s1))
+            return p, losses
+
         def body(p, inp):
             batch, ng = inp
             # with_metrics=False: the production steady state — the trainer
@@ -160,7 +190,7 @@ def bench_step(counts, b: int, pool: int, dtype: str = "float32",
             new_p, m = sgns_step_shared_core(
                 p, batch["centers"], batch["contexts"], batch["mask"],
                 ng, jnp.float32(0.025), NEG, "exact", cdt, False, ldt,
-                with_metrics=False)
+                with_metrics=False, fused=fused, bf16_chain=chain)
             return new_p, m.loss
 
         return jax.lax.scan(body, params, (batches, negs))
@@ -199,9 +229,8 @@ def bench_step(counts, b: int, pool: int, dtype: str = "float32",
     stats = {"ms_min": round(min(ts) * 1e3, 4),
              "ms_median": round(ms, 4),
              "ms_max": round(max(ts) * 1e3, 4)}
-    short = {"float32": "f32", "bfloat16": "bf16"}
-    label = (f"xla {short.get(param_dtype)}/logits-{short.get(logits_dtype)}"
-             f"{label_extra}")
+    label = (f"xla {_SHORT_DTYPE.get(param_dtype)}"
+             f"/logits-{_SHORT_DTYPE.get(logits_dtype)}{label_extra}")
     log(f"step {label:26s} V={v:8,d} B={b:6d} pool={pool:5d}: {ms:7.3f} ms/step"
         f" [{stats['ms_min']:.3f}-{stats['ms_max']:.3f}]"
         f" -> {pps:13,.0f} pairs/s  mfu={mfu * 100:5.2f}%")
@@ -279,7 +308,8 @@ def bench_cbow_step(counts, b: int, pools, param_dtype: str = "bfloat16",
         # a CBOW "example" trains ~mean(nctx) positive word-context links;
         # report examples/s (the step unit) and links/s for pair comparison
         eps = b / spp
-        log(f"step cbow scatter {param_dtype[:4]:9s} V={V:8,d} B={b:6d} "
+        short = _SHORT_DTYPE[param_dtype]
+        log(f"step cbow scatter {short:9s} V={V:8,d} B={b:6d} "
             f"pool={pool:5d}: {spp * 1e3:7.3f} ms/step -> {eps:13,.0f} "
             f"examples/s (~{eps * (C + 1) / 2:,.0f} word-link/s)")
         out[pool] = (eps, spp * 1e3)
@@ -347,7 +377,8 @@ def bench_cbow_banded_step(counts, b: int, pools, param_dtype: str = "bfloat16",
             ts.append(spc / K)
         spp = float(np.median(ts))
         eps = real_per_step / spp
-        log(f"step cbow banded  {param_dtype[:4]:9s} V={V:8,d} B={b:6d} "
+        short = _SHORT_DTYPE[param_dtype]
+        log(f"step cbow banded  {short:9s} V={V:8,d} B={b:6d} "
             f"pool={pool:5d}: {spp * 1e3:7.3f} ms/step -> {eps:13,.0f} "
             f"examples/s ({real_per_step:,.0f} real ex/step)")
         out[pool] = (eps, spp * 1e3)
@@ -529,7 +560,7 @@ def main() -> None:
     e2e = {}
     for dp, pdt, ldt in ((True, "bfloat16", "bfloat16"),
                          (False, "float32", "float32")):
-        key = f"{'device' if dp else 'host'}_{pdt[:4]}"
+        key = f"{'device' if dp else 'host'}_{_SHORT_DTYPE[pdt]}"
         try:
             e2e[key] = bench_e2e(dp, pdt, ldt, E2E_POOL)
         except Exception as e:
@@ -545,6 +576,27 @@ def main() -> None:
     rows["bf16_p1024"] = bench_step(counts, B_MAIN, 1024, dtype="bfloat16",
                                     param_dtype="bfloat16",
                                     logits_dtype="bfloat16")
+    # ISSUE-14 step-restructuring rows at the headline geometry, LAYERED so
+    # the trajectory shows which layer pays (PERF.md §11): the fused
+    # coefficient chain alone, + the end-to-end bf16 chain, + cross-step
+    # hot-row accumulation (K=4096 ≈ where the Zipf mass knee sits at
+    # V=200k; flush once per chunk, the trainer's AUTO cadence). Never the
+    # headline until their geometry carries its own EVAL evidence — the
+    # hot-row arm is gated by eval_quality --hotrow-ab.
+    bf16kw = dict(dtype="bfloat16", param_dtype="bfloat16",
+                  logits_dtype="bfloat16")
+    try:
+        rows["bf16_fused"] = bench_step(
+            counts, B_MAIN, E2E_POOL, fused=True,
+            label_extra=" +fused", **bf16kw)
+        rows["bf16_chain"] = bench_step(
+            counts, B_MAIN, E2E_POOL, fused=True, chain=True,
+            label_extra=" +fused+chain", **bf16kw)
+        rows["bf16_hot"] = bench_step(
+            counts, B_MAIN, E2E_POOL, fused=True, chain=True, hot_rows=4096,
+            label_extra=" +fused+chain+hot", **bf16kw)
+    except Exception as e:
+        log(f"restructured step rows failed: {type(e).__name__}: {e}")
     # CBOW rows at the same pool list as the SGNS step rows (comparable
     # geometry round to round): scatter (shipped default) and banded
     # (cbow_update="banded" — the ISSUE-2 prefix-sum path; step_ab.py --cbow
@@ -598,9 +650,11 @@ def main() -> None:
                 "bf16_p512": ("bfloat16", E2E_POOL, "bfloat16"),
                 "bf16_p1024": ("bfloat16", 1024, "bfloat16")}
     stable_keys = [k for k in rows
-                   if eval_stable(eval_rows, B_MAIN, dtype_of[k][1],
-                                  dtype_of[k][0], dtype_of[k][2],
-                                  E2E_SUBSAMPLE)]
+                   if k in dtype_of  # restructured rows never headline (they
+                                     # need their own EVAL evidence per arm)
+                   and eval_stable(eval_rows, B_MAIN, dtype_of[k][1],
+                                   dtype_of[k][0], dtype_of[k][2],
+                                   E2E_SUBSAMPLE)]
     if not stable_keys:
         log("WARNING: no step row has 60M-word EVAL evidence; refusing a step "
             "headline, publishing the e2e number instead")
@@ -628,6 +682,15 @@ def main() -> None:
         "v1m_step_trials_ms": scale.get("step_trials_ms"),
         "e2e_pairs_per_sec": round(e2e_pps) if e2e_pps else None,
         "e2e_feed": e2e_best_key,
+        # ISSUE-14 restructured step rows (same harness/geometry as the
+        # bf16_p512 row, so ratios are in-run honest; perfgate gates them
+        # from the first rung that carries them)
+        "step_fused_pairs_per_sec": (round(rows["bf16_fused"][0])
+                                     if "bf16_fused" in rows else None),
+        "step_bf16_chain_pairs_per_sec": (round(rows["bf16_chain"][0])
+                                          if "bf16_chain" in rows else None),
+        "step_hotrow_pairs_per_sec": (round(rows["bf16_hot"][0])
+                                      if "bf16_hot" in rows else None),
         "v1m_step_pairs_per_sec": (round(scale["step_bf16_pairs_per_sec"])
                                    if "step_bf16_pairs_per_sec" in scale
                                    else None),

@@ -193,6 +193,72 @@ class Word2VecConfig:
                                     # pool >= 512, several full passes over a [B, pool]
                                     # array (PERF.md §4) — coefficients are O(lr·n/pool)
                                     # and tolerate the ~0.4% relative noise
+    # --- step restructurings (ISSUE 14, PERF.md §11 — the emitter-ceiling
+    # levers; all off by default, and OFF ELIDES THE NEW OPS: the default
+    # step is bit-identical to the pre-restructure release, tested) ---
+    fused_logits: bool = False      # fuse the negative-logit coefficient chain
+                                    # (ops/sgns.py shared_pool_coeffs): validity
+                                    # + batch mask fold into ONE select and the
+                                    # alpha·negatives/pool reweight into one
+                                    # precomputed scalar, so the [B, pool] (or
+                                    # per-pair [B, n]) chain materializes only
+                                    # the dot output and the coefficient array
+                                    # instead of also a float validity array
+                                    # and its mask/alpha/reweight passes.
+                                    # Identical math (f64-oracle tested), not
+                                    # bit-identical (multiply association
+                                    # changes). SGNS paths only (per-pair,
+                                    # shared-pool GSPMD + shard_map); refused
+                                    # beside cbow/use_pallas/duplicate_scaling
+    bf16_chain: bool = False        # end-to-end reduced-precision update chain:
+                                    # the logit dots accumulate in
+                                    # promote(compute, f32) via
+                                    # preferred_element_type instead of a
+                                    # multiply + convert + reduce, so bf16 mode
+                                    # materializes NO dense f32 [B, D]
+                                    # intermediate (stepaudit dtype-contract
+                                    # row pins this) while keeping the R4 f32
+                                    # accumulation discipline. Requires
+                                    # compute_dtype='bfloat16' (with f32
+                                    # compute there is no chain to narrow) and,
+                                    # on the shared-pool paths, logits_dtype=
+                                    # 'bfloat16'. SGNS paths only; refused
+                                    # beside cbow/use_pallas
+    hot_rows: int = 0               # > 0: cross-step hot-row accumulation
+                                    # (ops/sgns.py hot_* helpers) — updates to
+                                    # the K most frequent rows (the vocabulary
+                                    # index prefix, by the sorted-by-frequency
+                                    # contract) accumulate in a float32 [K, D]
+                                    # slab across the steps of a dispatch chunk
+                                    # and flush as ONE dense block add per
+                                    # hot_flush_every steps, cutting the
+                                    # [V, D] scatter-emitter rows per step by
+                                    # the Zipf mass of the hot set. Reads stay
+                                    # exact (gathers add the pending deltas
+                                    # back), so this changes FP rounding order
+                                    # only — but that IS a semantic change at
+                                    # reduced precision, so it ships default-
+                                    # off behind the --hotrow-ab EVAL parity
+                                    # gate (tools/eval_quality.py). The
+                                    # trainer clamps K to the real vocabulary.
+                                    # Single-device SGNS XLA paths only:
+                                    # refused beside cbow/use_pallas/
+                                    # duplicate_scaling/shard_map/cols/multi-
+                                    # shard meshes/stabilizers (the post-
+                                    # scatter clamp would measure rows missing
+                                    # their pending deltas) and norm_watch=
+                                    # 'recover' (auto-engages the clamp)
+    hot_flush_every: int = 0        # hot_rows flush cadence in steps. 0
+                                    # (default) = AUTO: once per dispatch
+                                    # chunk (steps_per_dispatch). An explicit
+                                    # value must divide steps_per_dispatch —
+                                    # the slab lives in the chunk's scan
+                                    # carry, and every chunk flushes
+                                    # unconditionally at its end so the
+                                    # params carry leaving a dispatch is
+                                    # always complete (checkpoints/probes
+                                    # never see a pending slab). Inert when
+                                    # hot_rows=0
     use_pallas: bool = False        # fused Pallas SGNS kernel for the hot step
     sharded_checkpoint: bool = False  # row-shards save (each process writes its own
                                       # rows, no host gather — G9 analog); forced on
@@ -807,6 +873,140 @@ class Word2VecConfig:
             raise ValueError(
                 f"negative_pool must be nonnegative (or -1 for auto) "
                 f"but got {self.negative_pool}")
+        # --- step-restructuring selection matrix (ISSUE 14 / PERF.md §11;
+        # trainer._build_step carries the dispatch-side twins — graftlint R8
+        # refusal parity, graftcheck executes the empirical sweep). Every
+        # unsupported combination is an ERROR here, never a silent fallback:
+        #   fused_logits × use_pallas          → refuse (pallas owns the step)
+        #   fused_logits × cbow                → refuse (SGNS chains only; the
+        #       CBOW chain keeps the classic form until its own EVAL evidence)
+        #   fused_logits × duplicate_scaling   → refuse (mean semantics read
+        #       the per-pair coefficient arrays the fusion eliminates)
+        #   bf16_chain   × use_pallas/cbow     → refuse (as above)
+        #   bf16_chain   × compute f32         → refuse (no chain to narrow)
+        #   bf16_chain   × pool>0 + logits f32 → refuse (the [B, pool] chain
+        #       would silently stay f32 — exactly the half-applied state the
+        #       _build_step logits warning exists to avoid; per-pair pool=0
+        #       has no logits_dtype surface and is exempt)
+        #   hot_rows     × use_pallas/cbow/duplicate_scaling → refuse
+        #   hot_rows     × shard_map/cols      → refuse (the hot slab is the
+        #       GLOBAL index prefix [0, K); under the rows layout it lives
+        #       entirely on model shard 0 — owner-local accumulation would
+        #       serialize every hot update onto one shard. Documented initial
+        #       refusal, docs/sharding.md)
+        #   hot_rows     × multi-shard mesh    → refuse (single-chip path
+        #       initially; the trainer also refuses a multi-device plan)
+        #   hot_rows     × stabilizers/recover → refuse (the post-scatter
+        #       clamp would measure rows missing their pending slab deltas)
+        #   hot_flush_every (explicit)         → must divide steps_per_dispatch
+        if self.fused_logits:
+            if self.use_pallas:
+                raise ValueError(
+                    "fused_logits=True is an XLA-chain restructuring; "
+                    "use_pallas=True owns the whole step — drop one")
+            if self.cbow:
+                raise ValueError(
+                    "fused_logits=True is implemented for the SGNS logit "
+                    "chains only (per-pair and shared-pool); CBOW keeps the "
+                    "classic chain — set fused_logits=False")
+            if self.duplicate_scaling:
+                raise ValueError(
+                    "fused_logits=True does not support duplicate_scaling="
+                    "True: mean-update semantics read the per-pair "
+                    "coefficient arrays the fused chain eliminates — use "
+                    "the classic chain")
+        if self.bf16_chain:
+            if self.use_pallas:
+                raise ValueError(
+                    "bf16_chain=True is an XLA-chain restructuring; "
+                    "use_pallas=True owns the whole step — drop one")
+            if self.cbow:
+                raise ValueError(
+                    "bf16_chain=True is implemented for the SGNS paths "
+                    "only; CBOW keeps the classic chain — set "
+                    "bf16_chain=False")
+            if self.compute_dtype != "bfloat16":
+                raise ValueError(
+                    "bf16_chain=True requires compute_dtype='bfloat16' — "
+                    "with float32 compute there is no reduced-precision "
+                    "chain to carry end-to-end")
+            if self.negative_pool != 0 and self.logits_dtype != "bfloat16":
+                raise ValueError(
+                    "bf16_chain=True with a shared negative pool requires "
+                    "logits_dtype='bfloat16': a float32 [B, pool] logit "
+                    "chain would silently keep the dense traffic the knob "
+                    "exists to remove")
+        if self.hot_rows < 0:
+            raise ValueError(
+                f"hot_rows must be nonnegative (0 = off) "
+                f"but got {self.hot_rows}")
+        if self.hot_flush_every < 0:
+            raise ValueError(
+                f"hot_flush_every must be nonnegative (0 = auto: once per "
+                f"dispatch chunk) but got {self.hot_flush_every}")
+        if self.hot_rows:
+            if self.use_pallas:
+                raise ValueError(
+                    "hot_rows is not implemented for use_pallas=True — the "
+                    "fused kernel owns its own update math; use the XLA "
+                    "SGNS paths")
+            if self.cbow:
+                raise ValueError(
+                    "hot_rows is implemented for the SGNS paths only; CBOW "
+                    "keeps the classic per-step scatters — set hot_rows=0")
+            if self.duplicate_scaling:
+                raise ValueError(
+                    "hot_rows does not support duplicate_scaling=True: "
+                    "mean-update scaling and cross-step slab accumulation "
+                    "compose into semantics nothing has EVAL evidence for — "
+                    "use one or the other")
+            if self.step_lowering == "shard_map":
+                raise ValueError(
+                    "hot_rows has no shard_map form: the hot slab is the "
+                    "global index prefix [0, K), which under the rows "
+                    "layout lives entirely on model shard 0 — owner-local "
+                    "accumulation would serialize every hot update onto one "
+                    "shard (documented refusal, docs/sharding.md); use "
+                    "step_lowering='gspmd' on a single device")
+            if self.embedding_partition == "cols":
+                raise ValueError(
+                    "hot_rows requires the rows layout (the slab is a "
+                    "whole-row prefix block); embedding_partition='cols' "
+                    "owns columns — use 'rows'")
+            if self.num_model_shards > 1 or self.num_data_shards > 1:
+                raise ValueError(
+                    "hot_rows is the single-chip step restructuring "
+                    "(PERF.md §11); multi-shard meshes keep the classic "
+                    "scatters — set hot_rows=0 or use a 1x1 mesh")
+            if self.mesh_shape is not None and tuple(self.mesh_shape) != (1, 1):
+                raise ValueError(
+                    "hot_rows is the single-chip step restructuring "
+                    f"(PERF.md §11); mesh_shape={self.mesh_shape} keeps the "
+                    "classic scatters — set hot_rows=0 or use (1, 1)")
+            if self.max_row_norm or self.update_clip or self.row_l2:
+                raise ValueError(
+                    "hot_rows is incompatible with the in-step stabilizers "
+                    "(max_row_norm/update_clip/row_l2): the post-scatter "
+                    "touched-row pass would measure hot rows missing their "
+                    "pending slab deltas — clamping a partial row is the "
+                    "silent-distortion class the stabilizers exist to "
+                    "prevent; use one or the other")
+            if self.norm_watch == "recover":
+                raise ValueError(
+                    "hot_rows is incompatible with norm_watch='recover' "
+                    "(the recovery ladder auto-engages max_row_norm, which "
+                    "has no hot-row form); use norm_watch='warn'/'halt' or "
+                    "hot_rows=0")
+            if self.hot_flush_every and (
+                    self.hot_flush_every > self.steps_per_dispatch
+                    or self.steps_per_dispatch % self.hot_flush_every):
+                raise ValueError(
+                    f"hot_flush_every={self.hot_flush_every} must divide "
+                    f"steps_per_dispatch={self.steps_per_dispatch}: the hot "
+                    f"slab lives in the dispatch chunk's scan carry and "
+                    f"every chunk flushes at its end, so the cadence cannot "
+                    f"exceed or straddle the chunk (0 = auto: once per "
+                    f"chunk)")
         # --- step_lowering selection matrix (trainer._build_step dispatches on
         # it; every unsupported combination is an ERROR here, never a silent
         # fallback — same discipline as the CBOW matrix above):

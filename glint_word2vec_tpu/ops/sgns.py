@@ -145,6 +145,75 @@ def _mask_sentinel(idx: jax.Array, gate: jax.Array, vs: int) -> jax.Array:
     return jnp.where(gate > 0, idx, jnp.int32(vs))
 
 
+# ---------------------------------------------------------------------------
+# Cross-step hot-row accumulation (config.hot_rows — ISSUE 14, PERF.md §11).
+#
+# The vocabulary is sorted by descending frequency (data/vocab.py contract),
+# so rows 0..K−1 are exactly the words Zipf mass concentrates the per-step
+# update traffic on. The hot-row scheme diverts their updates into a small
+# [K, D] float32 slab carried across the steps of a dispatch chunk:
+#
+#   - READS stay exact: every gather adds the slab's pending delta back
+#     (hot_gather), so no step ever trains on a stale hot row — the scheme
+#     changes floating-point ORDER (per-step param-dtype rounding becomes
+#     one f32-accumulated add per flush window), never the update math.
+#   - WRITES split (hot_scatter_add): indices < K accumulate into the slab
+#     (a scatter whose target is K rows, small enough to live in VMEM/cache),
+#     indices >= K take the normal [V, D] scatter with the hot candidates
+#     remapped to the OOB drop sentinel — the §3-measured cheap regime.
+#   - FLUSH (hot_flush): because the hot set is the CONTIGUOUS index prefix,
+#     the flush is one dense [K, D] block add (static slice + add + update —
+#     no scatter emitter at all), once per `hot_flush_every` steps.
+#
+# The slab accumulates in float32 regardless of param dtype (R4: cross-step
+# bf16 accumulation would round away exactly the small frequent-row updates
+# the scheme batches). The trainer flushes unconditionally at the end of
+# every dispatch chunk, so the params carry leaving a chunk is always
+# complete — checkpoints, probes, and donation never see a pending slab.
+# ---------------------------------------------------------------------------
+
+
+def hot_gather(mat: jax.Array, slab: jax.Array, idx: jax.Array,
+               compute_dtype: jnp.dtype) -> jax.Array:
+    """``mat[idx]`` with the hot slab's pending deltas added back for
+    ``idx < K`` — the read-freshness half of the hot-row contract. ``idx``
+    may be any shape; returns ``[..., D]`` in ``compute_dtype``."""
+    k = slab.shape[0]
+    rows = mat[idx].astype(compute_dtype)
+    hot = idx < k
+    pend = jnp.where(hot[..., None],
+                     slab[jnp.where(hot, idx, 0)].astype(compute_dtype),
+                     jnp.zeros((), compute_dtype))
+    return rows + pend
+
+
+def hot_scatter_add(
+    mat: jax.Array,    # [V, D] param matrix
+    slab: jax.Array,   # [K, D] float32 pending-delta slab
+    idx: jax.Array,    # int32 [N] (flattened by the caller if needed)
+    upd: jax.Array,    # [N, D] update rows (compute dtype)
+) -> Tuple[jax.Array, jax.Array]:
+    """Split scatter-add: rows ``idx < K`` accumulate into the f32 slab,
+    the rest into the matrix; each side drops the other's candidates via the
+    OOB sentinel (mode="drop"), so every update lands exactly once."""
+    k = slab.shape[0]
+    v = mat.shape[0]
+    cold = jnp.where(idx < k, jnp.int32(v), idx)
+    mat = mat.at[cold].add(upd.astype(mat.dtype), mode="drop")
+    hot = jnp.where(idx < k, idx, jnp.int32(k))
+    slab = slab.at[hot].add(upd.astype(slab.dtype), mode="drop")
+    return mat, slab
+
+
+def hot_flush(mat: jax.Array, slab: jax.Array) -> jax.Array:
+    """Apply the accumulated hot-row deltas: ONE dense [K, D] block add over
+    the contiguous index prefix (static slice — lowers to slice/add/update,
+    zero scatter-emitter rows; the "one sorted scatter" of the design, made
+    degenerate by the frequency-sorted vocabulary contract)."""
+    k = slab.shape[0]
+    return mat.at[:k].add(slab.astype(mat.dtype))
+
+
 class EmbeddingPair(NamedTuple):
     """The two trainable matrices: input (syn0) and output (syn1neg) embeddings —
     the reference's ``BigWord2VecMatrix`` pair (G2, README.md:69)."""
@@ -258,7 +327,10 @@ def sgns_step_core(
     compute_dtype: jnp.dtype = jnp.float32,
     duplicate_scaling: bool = False,
     stabilizers: Optional[Stabilizers] = None,
-) -> Tuple[EmbeddingPair, StepMetrics]:
+    fused: bool = False,
+    bf16_chain: bool = False,
+    hot_slabs: Optional[Tuple[jax.Array, jax.Array]] = None,
+):
     """:func:`sgns_step` with the negatives supplied by the caller — the form the
     trainer jits (sampling happens once per dispatch chunk, outside the scan, because
     in-program threefry is catastrophically slow on TPU; see ops/prng.py).
@@ -267,22 +339,58 @@ def sgns_step_core(
     caps every per-pair update row (d_in, d_pos, and — per-pair negatives
     being per-pair rows — d_neg); the post-scatter pass clamps/decays the
     touched rows: syn0 at the unmasked centers, syn1 at the unmasked contexts
-    plus the negatives of unmasked pairs (see :class:`Stabilizers`)."""
+    plus the negatives of unmasked pairs (see :class:`Stabilizers`).
+
+    ``fused``/``bf16_chain``/``hot_slabs``: the per-pair forms of the ISSUE-14
+    step restructurings (see :func:`sgns_step_shared_core` for semantics):
+    fused folds validity+mask+α into one [B, n] select with a precomputed
+    scalar; bf16_chain accumulates both logit dots in promote(compute, f32)
+    via ``preferred_element_type`` (the per-pair chain previously ran the
+    einsum in compute dtype and upcast AFTER — chain mode is the stricter R4
+    form); hot_slabs routes updates through the cross-step hot-row slabs.
+    All default off; off elides the new ops entirely (bit-identical step)."""
     syn0, syn1 = params
     V = syn0.shape[0]
-    neg_valid = (negatives != contexts[:, None]).astype(jnp.float32) * mask[:, None]
+    if duplicate_scaling and (fused or hot_slabs is not None):
+        raise ValueError("duplicate_scaling has no fused/hot-row form "
+                         "(refused at config construction)")
+    if hot_slabs is not None and stabilizers is not None:
+        raise ValueError("stabilizers have no hot-row form (refused at "
+                         "config construction)")
+    if not fused:
+        neg_valid = (negatives != contexts[:, None]).astype(jnp.float32) \
+            * mask[:, None]
 
-    e_in = syn0[centers].astype(compute_dtype)          # [B, D]
-    e_pos = syn1[contexts].astype(compute_dtype)        # [B, D]
-    e_neg = syn1[negatives].astype(compute_dtype)       # [B, n, D]
+    if hot_slabs is not None:
+        slab0, slab1 = hot_slabs
+        e_in = hot_gather(syn0, slab0, centers, compute_dtype)    # [B, D]
+        e_pos = hot_gather(syn1, slab1, contexts, compute_dtype)  # [B, D]
+        e_neg = hot_gather(syn1, slab1, negatives, compute_dtype)  # [B, n, D]
+    else:
+        e_in = syn0[centers].astype(compute_dtype)          # [B, D]
+        e_pos = syn1[contexts].astype(compute_dtype)        # [B, D]
+        e_neg = syn1[negatives].astype(compute_dtype)       # [B, n, D]
 
-    f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)          # [B]
-    f_neg = jnp.einsum("bd,bnd->bn", e_in, e_neg).astype(jnp.float32)   # [B, n]
+    if bf16_chain:
+        pf = jnp.promote_types(compute_dtype, jnp.float32)
+        f_pos = jnp.einsum("bd,bd->b", e_in, e_pos,
+                           preferred_element_type=pf).astype(jnp.float32)
+        f_neg = jnp.einsum("bd,bnd->bn", e_in, e_neg,
+                           preferred_element_type=pf).astype(jnp.float32)
+    else:
+        f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)        # [B]
+        f_neg = jnp.einsum("bd,bnd->bn", e_in, e_neg).astype(jnp.float32)  # [B, n]
 
     # Gradient coefficients, exactly the reference's client-side math (mllib:421-425):
     # gPlus = (1 − σ(f))·α for label 1, gMinus = (0 − σ(f))·α for label 0.
     g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask               # [B]
-    g_neg = (0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid          # [B, n]
+    if fused:
+        valid = (negatives != contexts[:, None]) & (mask[:, None] > 0)
+        g_neg = jnp.where(valid, _sigmoid(f_neg, sigmoid_mode) * (-alpha),
+                          jnp.zeros((), f_neg.dtype))                  # [B, n]
+        neg_valid = valid
+    else:
+        g_neg = (0.0 - _sigmoid(f_neg, sigmoid_mode)) * alpha * neg_valid  # [B, n]
 
     if duplicate_scaling:
         cnt0 = jnp.zeros(V, jnp.float32).at[centers].add(mask)
@@ -306,11 +414,17 @@ def sgns_step_core(
         d_neg = clip_update_rows(d_neg, stabilizers.update_clip)
 
     dtype = syn0.dtype
-    new_syn0 = syn0.at[centers].add(d_in.astype(dtype))
-    new_syn1 = syn1.at[contexts].add(d_pos.astype(dtype))
     D = syn1.shape[1]
-    new_syn1 = new_syn1.at[negatives.reshape(-1)].add(
-        d_neg.reshape(-1, D).astype(dtype))
+    if hot_slabs is not None:
+        new_syn0, slab0 = hot_scatter_add(syn0, slab0, centers, d_in)
+        new_syn1, slab1 = hot_scatter_add(syn1, slab1, contexts, d_pos)
+        new_syn1, slab1 = hot_scatter_add(
+            new_syn1, slab1, negatives.reshape(-1), d_neg.reshape(-1, D))
+    else:
+        new_syn0 = syn0.at[centers].add(d_in.astype(dtype))
+        new_syn1 = syn1.at[contexts].add(d_pos.astype(dtype))
+        new_syn1 = new_syn1.at[negatives.reshape(-1)].add(
+            d_neg.reshape(-1, D).astype(dtype))
     if stabilizers is not None and stabilizers.post_pass:
         enable = (mask.sum() > 0).astype(jnp.float32)
         new_syn0 = stabilize_rows(
@@ -324,13 +438,20 @@ def sgns_step_core(
         new_syn1 = stabilize_rows(new_syn1, idx1, alpha, stabilizers, enable)
 
     denom = jnp.maximum(mask.sum(), 1.0)
-    loss = (-_log_sigmoid(f_pos) * mask
-            - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1)).sum() / denom
+    if fused:
+        neg_loss = jnp.sum(
+            jnp.where(neg_valid, _log_sigmoid(-f_neg),
+                      jnp.zeros((), f_neg.dtype)), axis=-1)
+    else:
+        neg_loss = jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1)
+    loss = (-_log_sigmoid(f_pos) * mask - neg_loss).sum() / denom
     metrics = StepMetrics(
         loss=loss,
         mean_f_pos=(f_pos * mask).sum() / denom,
         pairs=mask.sum(),
     )
+    if hot_slabs is not None:
+        return EmbeddingPair(new_syn0, new_syn1), metrics, (slab0, slab1)
     return EmbeddingPair(new_syn0, new_syn1), metrics
 
 
@@ -345,19 +466,50 @@ def shared_pool_coeffs(
     num_negatives: int,
     sigmoid_mode: str,
     logits_dtype: jnp.dtype,
+    fused: bool = False,
+    bf16_chain: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """The shared-pool logit chain: (f_pos, f_neg, neg_valid, g_pos, g_neg).
 
     Extracted so the GSPMD step (:func:`sgns_step_shared_core`) and the
     explicit shard_map lowering (:mod:`.sgns_shard`) run op-for-op identical
     coefficient math — the two lowerings must never drift in anything but
-    collective placement."""
+    collective placement.
+
+    ``fused`` (config.fused_logits): collapse the [B, P] chain to ONE
+    coefficient expression — validity (pool entry == pair's positive) and
+    the batch mask fold into a single select predicate, and the
+    α·negatives/P reweight folds into one precomputed scalar, so the chain
+    materializes only f_neg (the dot output) and g_neg instead of also the
+    float neg_valid array and its mask/α/reweight elementwise passes
+    (PERF.md §11). ``neg_valid`` is then returned as the BOOL predicate —
+    consumed only by the metrics twin's loss pass (dead code in the elided
+    production twin). Off (default) keeps the pre-fusion chain op-for-op.
+
+    ``bf16_chain`` (config.bf16_chain): compute the positive logit as a
+    dot_general accumulating in promote(compute, f32) via
+    ``preferred_element_type`` instead of a multiply + convert-to-f32 +
+    reduce — same R4 accumulation discipline WITHOUT the dense f32 [B, D]
+    product the sum-based form materializes in bf16 mode (the new stepaudit
+    dtype-contract row pins this on the lowered module)."""
     P = negatives.shape[0]
-    f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)
+    if bf16_chain:
+        pf = jnp.promote_types(e_in.dtype, jnp.float32)
+        f_pos = jnp.einsum("bd,bd->b", e_in, e_pos,
+                           preferred_element_type=pf).astype(jnp.float32)
+    else:
+        f_pos = jnp.sum(e_in * e_pos, axis=-1).astype(jnp.float32)
     f_neg = (e_in @ Z.T).astype(logits_dtype)           # [B, P] — MXU
+    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask
+    if fused:
+        valid = ((negatives[None, :] != contexts[:, None])
+                 & (mask[:, None] > 0))                 # bool [B, P]
+        neg_scale = (alpha * (0.0 - num_negatives / P)).astype(logits_dtype)
+        g_neg = jnp.where(valid, _sigmoid(f_neg, sigmoid_mode) * neg_scale,
+                          jnp.zeros((), logits_dtype))
+        return f_pos, f_neg, valid, g_pos, g_neg
     neg_valid = (negatives[None, :] != contexts[:, None]).astype(logits_dtype) \
         * mask[:, None].astype(logits_dtype)
-    g_pos = (1.0 - _sigmoid(f_pos, sigmoid_mode)) * alpha * mask
     g_neg = ((0.0 - _sigmoid(f_neg, sigmoid_mode))
              * jnp.asarray(alpha, logits_dtype) * neg_valid
              * jnp.asarray(num_negatives / P, logits_dtype))
@@ -374,12 +526,20 @@ def shared_pool_loss_terms(
     """Pre-division loss/mean_f_pos numerators (scalars). Shared by both
     lowerings; the shard_map step psums these across data shards before
     dividing by the global pair count, the single-program step divides
-    directly — same math either way."""
+    directly — same math either way. ``neg_valid`` may be the classic float
+    validity array or the fused chain's bool predicate (a select replaces
+    the multiply — identical masking, one fewer [B, P] float array)."""
     P = f_neg.shape[-1]
+    if neg_valid.dtype == jnp.bool_:
+        neg_term = jnp.sum(
+            jnp.where(neg_valid, _log_sigmoid(-f_neg),
+                      jnp.zeros((), f_neg.dtype)),
+            axis=-1, dtype=jnp.float32)
+    else:
+        neg_term = jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1,
+                           dtype=jnp.float32)
     loss_num = (-_log_sigmoid(f_pos) * mask
-                - jnp.sum(_log_sigmoid(-f_neg) * neg_valid, axis=-1,
-                          dtype=jnp.float32)
-                * (num_negatives / P)).sum()
+                - neg_term * (num_negatives / P)).sum()
     return loss_num, (f_pos * mask).sum()
 
 
@@ -429,9 +589,29 @@ def sgns_step_shared_core(
     logits_dtype: jnp.dtype = jnp.float32,
     with_metrics: bool = True,
     stabilizers: Optional[Stabilizers] = None,
-) -> Tuple[EmbeddingPair, StepMetrics]:
+    fused: bool = False,
+    bf16_chain: bool = False,
+    hot_slabs: Optional[Tuple[jax.Array, jax.Array]] = None,
+):
     """:func:`sgns_step_shared` with the pool supplied by the caller (see
     :func:`sgns_step_core` for why sampling lives outside the jitted scan).
+
+    ``fused``/``bf16_chain`` (config.fused_logits / config.bf16_chain —
+    ISSUE 14): the fused coefficient chain and the f32-accumulating dot
+    restructurings of :func:`shared_pool_coeffs`; both default off, and off
+    elides the new ops entirely (the step is bit-identical to the
+    pre-restructure release — tested). Neither supports
+    ``duplicate_scaling`` (the mean-update scaling reads the per-pair
+    coefficient arrays the fusion eliminates; refused at config).
+
+    ``hot_slabs`` (config.hot_rows): the cross-step hot-row accumulation
+    slabs ``(slab0, slab1)`` — f32 [K, D] pending deltas for syn0/syn1's
+    first K rows, carried across the dispatch chunk's scan by the trainer.
+    When given, gathers read through :func:`hot_gather` (pending deltas
+    added back — no staleness), scatters split through
+    :func:`hot_scatter_add`, and the return grows a third element with the
+    updated slabs. Incompatible with stabilizers (the post-scatter clamp
+    would measure rows missing their pending deltas; refused at config).
 
     ``stabilizers`` (None/all-zero = off, bit-identical step): ``update_clip``
     caps the per-pair d_in/d_pos rows (NOT the pool deltas d_Z — see
@@ -467,13 +647,26 @@ def sgns_step_shared_core(
     no heartbeat will sample."""
     syn0, syn1 = params
     V = syn0.shape[0]
-    e_in = syn0[centers].astype(compute_dtype)          # [B, D]
-    e_pos = syn1[contexts].astype(compute_dtype)        # [B, D]
-    Z = syn1[negatives].astype(compute_dtype)           # [P, D]
+    if duplicate_scaling and (fused or hot_slabs is not None):
+        raise ValueError("duplicate_scaling has no fused/hot-row form "
+                         "(refused at config construction)")
+    if hot_slabs is not None and stabilizers is not None:
+        raise ValueError("stabilizers have no hot-row form (refused at "
+                         "config construction)")
+    if hot_slabs is not None:
+        slab0, slab1 = hot_slabs
+        e_in = hot_gather(syn0, slab0, centers, compute_dtype)    # [B, D]
+        e_pos = hot_gather(syn1, slab1, contexts, compute_dtype)  # [B, D]
+        Z = hot_gather(syn1, slab1, negatives, compute_dtype)     # [P, D]
+    else:
+        e_in = syn0[centers].astype(compute_dtype)          # [B, D]
+        e_pos = syn1[contexts].astype(compute_dtype)        # [B, D]
+        Z = syn1[negatives].astype(compute_dtype)           # [P, D]
 
     f_pos, f_neg, neg_valid, g_pos, g_neg = shared_pool_coeffs(
         e_in, e_pos, Z, contexts, negatives, mask, alpha,
-        num_negatives, sigmoid_mode, logits_dtype)
+        num_negatives, sigmoid_mode, logits_dtype,
+        fused=fused, bf16_chain=bf16_chain)
 
     if duplicate_scaling:
         cnt0 = jnp.zeros(V, jnp.float32).at[centers].add(mask)
@@ -506,9 +699,14 @@ def sgns_step_shared_core(
         d_pos = clip_update_rows(d_pos, stabilizers.update_clip)
 
     dtype = syn0.dtype
-    new_syn0 = syn0.at[centers].add(d_in.astype(dtype))
-    new_syn1 = syn1.at[contexts].add(d_pos.astype(dtype))
-    new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
+    if hot_slabs is not None:
+        new_syn0, slab0 = hot_scatter_add(syn0, slab0, centers, d_in)
+        new_syn1, slab1 = hot_scatter_add(syn1, slab1, contexts, d_pos)
+        new_syn1, slab1 = hot_scatter_add(new_syn1, slab1, negatives, d_Z)
+    else:
+        new_syn0 = syn0.at[centers].add(d_in.astype(dtype))
+        new_syn1 = syn1.at[contexts].add(d_pos.astype(dtype))
+        new_syn1 = new_syn1.at[negatives].add(d_Z.astype(dtype))
     if stabilizers is not None and stabilizers.post_pass:
         enable = (mask.sum() > 0).astype(jnp.float32)
         new_syn0 = stabilize_rows(
@@ -531,6 +729,8 @@ def sgns_step_shared_core(
         mean_f_pos=mean_f_pos,
         pairs=mask.sum(),
     )
+    if hot_slabs is not None:
+        return EmbeddingPair(new_syn0, new_syn1), metrics, (slab0, slab1)
     return EmbeddingPair(new_syn0, new_syn1), metrics
 
 

@@ -98,6 +98,8 @@ def make_shard_map_sgns_step(
     logits_dtype: jnp.dtype = jnp.float32,
     with_metrics: bool = True,
     stabilizers: Optional[Stabilizers] = None,
+    fused: bool = False,
+    bf16_chain: bool = False,
 ) -> Callable[..., Tuple[EmbeddingPair, StepMetrics]]:
     """Build the explicitly-scheduled sharded step. The returned function has
     the trainer's ``inner`` signature — ``(params, batch, negatives, alpha) ->
@@ -110,6 +112,20 @@ def make_shard_map_sgns_step(
     batch divides ``num_data``. ``duplicate_scaling`` has no shard_map form
     (global in-batch occurrence counts would need a [V]-sized psum) — the
     config selection matrix refuses the combination up front.
+
+    ``fused``/``bf16_chain`` (config.fused_logits / config.bf16_chain —
+    ISSUE 14): the coefficient chain lives in the shared
+    :func:`..sgns.shared_pool_coeffs` helper, so the fused select chain and
+    the f32-accumulating positive dot apply to this lowering by
+    construction — the two lowerings cannot drift. The per-data-shard
+    [Bl, P] chain shrinks exactly like the single-program [B, P] one; the
+    collective schedule is untouched (the fusion is local elementwise
+    restructuring, no new cross-shard values). ``hot_rows`` has NO shard_map
+    form and is refused at config construction: the hot slab covers the
+    global index prefix [0, K), which under the rows layout lives entirely
+    on model shard 0 — accumulating it owner-locally would serialize every
+    hot update onto one shard, the exact imbalance the owner-local schedule
+    exists to avoid (docs/sharding.md records the refusal contract).
     """
     nd = mesh.shape[DATA_AXIS]
     nm = mesh.shape[MODEL_AXIS]
@@ -152,7 +168,8 @@ def make_shard_map_sgns_step(
         # the GSPMD step runs (ops/sgns.py), per data shard
         f_pos, f_neg, neg_valid, g_pos, g_neg = shared_pool_coeffs(
             e_in, e_pos, Z, contexts, negatives, mask, alpha,
-            num_negatives, sigmoid_mode, logits_dtype)
+            num_negatives, sigmoid_mode, logits_dtype,
+            fused=fused, bf16_chain=bf16_chain)
         gn = g_neg.astype(compute_dtype)
         d_in = g_pos[:, None].astype(compute_dtype) * e_pos + gn @ Z
         d_pos = g_pos[:, None].astype(compute_dtype) * e_in

@@ -39,6 +39,7 @@ from glint_word2vec_tpu.ops.sgns import (
     alpha_schedule,
     cbow_step_core,
     cbow_step_shared_core,
+    hot_flush,
     init_embeddings,
     sgns_step_core,
     sgns_step_shared_core,
@@ -492,6 +493,27 @@ class Trainer:
             max_row_norm=config.max_row_norm,
             update_clip=config.update_clip,
             row_l2=config.row_l2)
+        # cross-step hot-row accumulation (config.hot_rows, ISSUE 14 /
+        # PERF.md §11): K clamped to the REAL vocabulary — config cannot see
+        # it, and the padding rows past vocab.size are never touched by
+        # construction so a slab covering them would waste VMEM. The flush
+        # cadence resolves AUTO (0) to once per dispatch chunk; config
+        # already refused explicit values that do not divide the chunk.
+        self._hot_rows = 0
+        self._hot_flush = 0
+        if config.hot_rows:
+            if len(plan.mesh.devices.flat) > 1:
+                # runtime twin of the config-side multi-shard refusal (the
+                # plan's device count is state config cannot see — same
+                # split as the pallas multi-device guard)
+                raise ValueError(
+                    "hot_rows is the single-chip step restructuring "
+                    "(PERF.md §11) and the mesh plan has "
+                    f"{len(plan.mesh.devices.flat)} devices; use a "
+                    "single-device plan or hot_rows=0")
+            self._hot_rows = int(min(config.hot_rows, vocab.size))
+            self._hot_flush = (config.hot_flush_every
+                               or config.steps_per_dispatch)
         self._lr_scale = 1.0
         self.recoveries_performed = 0
         self._health_fn: Optional[Callable] = None  # fused probe (obs/probe.py)
@@ -858,6 +880,27 @@ class Trainer:
         # when all off, so the default step compiles bit-identical to the
         # pre-stabilizer step.
         stab = self._stabilizers if self._stabilizers.enabled else None
+        # ISSUE-14 step restructurings: dispatch-side twins of the config
+        # selection matrix (construction already refused these — graftlint R8
+        # refusal parity; kept here so a hand-mutated config can never reach
+        # an unsupported lowering), plus the resolved hot-row geometry.
+        if cfg.hot_rows and (cfg.use_pallas or cfg.cbow
+                             or cfg.step_lowering == "shard_map"
+                             or cfg.duplicate_scaling):
+            raise ValueError(
+                "hot_rows supports the single-device SGNS XLA paths only "
+                "(not use_pallas/cbow/shard_map/duplicate_scaling) — config "
+                "construction refuses these combinations (docs/sharding.md)")
+        if (cfg.fused_logits or cfg.bf16_chain) and (
+                cfg.use_pallas or cfg.cbow):
+            raise ValueError(
+                "fused_logits/bf16_chain support the SGNS XLA chains only "
+                "(not use_pallas/cbow) — config construction refuses these "
+                "combinations")
+        fused = cfg.fused_logits
+        chain = cfg.bf16_chain
+        hot_k = self._hot_rows
+        inner_hot = None
         if not quiet and logits_dtype != jnp.float32 and not (
                 cfg.negative_pool > 0 and not cfg.use_pallas
                 and not (cfg.cbow and cfg.duplicate_scaling)):
@@ -948,7 +991,8 @@ class Trainer:
                     make_shard_map_sgns_step)
                 inner = make_shard_map_sgns_step(
                     plan.mesh, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
-                    logits_dtype, with_metrics, stabilizers=stab)
+                    logits_dtype, with_metrics, stabilizers=stab,
+                    fused=fused, bf16_chain=chain)
             else:
                 def inner(params, batch, negatives, alpha):
                     return sgns_step_shared_core(
@@ -956,7 +1000,17 @@ class Trainer:
                         batch["mask"], negatives, alpha, cfg.negatives,
                         cfg.sigmoid_mode, compute_dtype,
                         cfg.duplicate_scaling, logits_dtype, with_metrics,
-                        stabilizers=stab)
+                        stabilizers=stab, fused=fused, bf16_chain=chain)
+
+                if hot_k:
+                    def inner_hot(params, slabs, batch, negatives, alpha):
+                        return sgns_step_shared_core(
+                            params, batch["centers"], batch["contexts"],
+                            batch["mask"], negatives, alpha, cfg.negatives,
+                            cfg.sigmoid_mode, compute_dtype,
+                            cfg.duplicate_scaling, logits_dtype,
+                            with_metrics, stabilizers=stab, fused=fused,
+                            bf16_chain=chain, hot_slabs=slabs)
 
             neg_shape = shared_pool_shape
         elif cfg.cbow and cfg.negative_pool > 0 and not cfg.duplicate_scaling:
@@ -994,7 +1048,17 @@ class Trainer:
                 return sgns_step_core(
                     params, batch["centers"], batch["contexts"], batch["mask"],
                     negatives, alpha, cfg.sigmoid_mode, compute_dtype,
-                    cfg.duplicate_scaling, stabilizers=stab)
+                    cfg.duplicate_scaling, stabilizers=stab,
+                    fused=fused, bf16_chain=chain)
+
+            if hot_k:
+                def inner_hot(params, slabs, batch, negatives, alpha):
+                    return sgns_step_core(
+                        params, batch["centers"], batch["contexts"],
+                        batch["mask"], negatives, alpha, cfg.sigmoid_mode,
+                        compute_dtype, cfg.duplicate_scaling,
+                        stabilizers=stab, fused=fused, bf16_chain=chain,
+                        hot_slabs=slabs)
 
             neg_shape = lambda K, B: (K, B, cfg.negatives)  # noqa: E731
 
@@ -1027,21 +1091,38 @@ class Trainer:
                 params, arrays, negatives = jax.lax.optimization_barrier(
                     (params, arrays, negatives))
 
-                def body(p, inp):
-                    xs, alpha, nv, negs = inp
+                def build_batch(xs, nv):
                     ob = jax.lax.bitcast_convert_type(xs["obase"], jnp.uint32)
                     dp = gen(xs["tokens"].astype(jnp.int32), xs["starts"],
                              nv.astype(jnp.int32), ob[:, 0], ob[:, 1],
                              keep_prob, sub_bases, win_bases)
-                    batch = {"centers": dp.centers.reshape(-1),
-                             "contexts": dp.contexts.reshape(-1),
-                             "mask": dp.mask.reshape(-1)}
+                    return {"centers": dp.centers.reshape(-1),
+                            "contexts": dp.contexts.reshape(-1),
+                            "mask": dp.mask.reshape(-1)}, dp.dropped_pairs.sum()
+
+                def body(p, inp):
+                    xs, alpha, nv, negs = inp
+                    batch, dropped = build_batch(xs, nv)
                     new_p, metrics = inner(p, batch, negs, alpha)
                     new_p = jax.lax.with_sharding_constraint(
                         new_p, EmbeddingPair(emb_sharding, emb_sharding))
-                    return new_p, (metrics, dp.dropped_pairs.sum())
+                    return new_p, (metrics, dropped)
 
-                return jax.lax.scan(body, params, (arrays, alphas, nvalid, negatives))
+                xs_all = (arrays, alphas, nvalid, negatives)
+                if not hot_k:
+                    return jax.lax.scan(body, params, xs_all)
+
+                def body_hot(carry, inp):
+                    p, slabs = carry
+                    xs, alpha, nv, negs = inp
+                    batch, dropped = build_batch(xs, nv)
+                    new_p, metrics, slabs = inner_hot(p, slabs, batch, negs,
+                                                      alpha)
+                    new_p = jax.lax.with_sharding_constraint(
+                        new_p, EmbeddingPair(emb_sharding, emb_sharding))
+                    return (new_p, slabs), (metrics, dropped)
+
+                return self._run_hot_scan(body_hot, params, xs_all, K)
 
             return jax.jit(device_chunk, donate_argnums=(0,))
 
@@ -1086,8 +1167,7 @@ class Trainer:
                 (params, arrays, negatives))
             pos = jnp.arange(B // S, dtype=jnp.float32)
 
-            def body(p, inp):
-                xs, alpha, real, negs = inp
+            def build_batch(xs, real):
                 mask = (pos[None, :] < real[:, None]).astype(jnp.float32).reshape(-1)
                 if is_cbow:
                     ctx = xs["contexts"].astype(jnp.int32)
@@ -1096,17 +1176,32 @@ class Trainer:
                     nctx = xs["nctx"].astype(jnp.int32)
                     ctx_mask = (jnp.arange(ctx.shape[-1])[None, :]
                                 < nctx[:, None]).astype(jnp.float32)
-                    batch = {"centers": xs["centers"].astype(jnp.int32),
-                             "contexts": ctx, "ctx_mask": ctx_mask, "mask": mask}
-                else:
-                    prs = xs["pairs"].astype(jnp.int32)
-                    batch = {"centers": prs[0], "contexts": prs[1], "mask": mask}
-                new_p, metrics = inner(p, batch, negs, alpha)
+                    return {"centers": xs["centers"].astype(jnp.int32),
+                            "contexts": ctx, "ctx_mask": ctx_mask, "mask": mask}
+                prs = xs["pairs"].astype(jnp.int32)
+                return {"centers": prs[0], "contexts": prs[1], "mask": mask}
+
+            def body(p, inp):
+                xs, alpha, real, negs = inp
+                new_p, metrics = inner(p, build_batch(xs, real), negs, alpha)
                 new_p = jax.lax.with_sharding_constraint(
                     new_p, EmbeddingPair(emb_sharding, emb_sharding))
                 return new_p, metrics
 
-            return jax.lax.scan(body, params, (arrays, alphas, reals, negatives))
+            xs_all = (arrays, alphas, reals, negatives)
+            if not hot_k:
+                return jax.lax.scan(body, params, xs_all)
+
+            def body_hot(carry, inp):
+                p, slabs = carry
+                xs, alpha, real, negs = inp
+                new_p, metrics, slabs = inner_hot(
+                    p, slabs, build_batch(xs, real), negs, alpha)
+                new_p = jax.lax.with_sharding_constraint(
+                    new_p, EmbeddingPair(emb_sharding, emb_sharding))
+                return (new_p, slabs), metrics
+
+            return self._run_hot_scan(body_hot, params, xs_all, K)
 
         return jax.jit(chunk, donate_argnums=(0,))
 
@@ -1173,6 +1268,44 @@ class Trainer:
             return jax.lax.scan(body, params, (arrays, alphas, nvalid, negatives))
 
         return jax.jit(banded_chunk, donate_argnums=(0,))
+
+    def _run_hot_scan(self, body_hot, params, xs, K: int):
+        """Cross-step hot-row scan (config.hot_rows — ISSUE 14 / PERF.md §11):
+        the chunk's scan carries the two f32 [K_hot, D] pending-delta slabs
+        beside the params, and the chunk splits into ``steps_per_dispatch /
+        hot_flush_every`` statically-unrolled scan segments with ONE dense
+        prefix-block flush (ops/sgns.hot_flush — no scatter emitter) between
+        segments and after the last. The final flush makes the returned
+        params complete, so the chunk's external contract — (params, stacked
+        per-step outputs) — is unchanged: checkpoints, probes, donation, and
+        the heartbeat metrics path never see a pending slab. ``body_hot``
+        has signature ``((params, slabs), inp) -> ((params, slabs), ys)``;
+        config guarantees ``hot_flush_every`` divides ``K``."""
+        hk, dp = self._hot_rows, self.padded_dim
+        F = min(self._hot_flush, K)
+        # slab accumulation dtype: promote(param, f32) — the R4 discipline
+        # (cross-step bf16 accumulation would round away exactly the small
+        # frequent-row updates the slab batches), never below the params'
+        # own precision (the f64 oracle suite holds the helpers exact)
+        sdt = jnp.promote_types(jnp.dtype(self.config.param_dtype),
+                                jnp.float32)
+
+        def zero_slabs():
+            return (jnp.zeros((hk, dp), sdt), jnp.zeros((hk, dp), sdt))
+
+        carry = (params, zero_slabs())
+        outs = []
+        for si in range(max(1, K // F)):
+            seg = jax.tree.map(lambda a, si=si: a[si * F:(si + 1) * F], xs)
+            carry, ys = jax.lax.scan(body_hot, carry, seg)
+            p, (s0, s1) = carry
+            p = EmbeddingPair(hot_flush(p.syn0, s0), hot_flush(p.syn1, s1))
+            carry = (p, zero_slabs())
+            outs.append(ys)
+        if len(outs) == 1:
+            return carry[0], outs[0]
+        return carry[0], jax.tree.map(
+            lambda *a: jnp.concatenate(a, axis=0), *outs)
 
     def _stage_dispatch_meta(self, meta: np.ndarray, base_step, *bases):
         """Explicitly stage the small per-dispatch host arrays (the meta rows,

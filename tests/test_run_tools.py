@@ -4,6 +4,7 @@ run_report folds it into ONE machine JSON line (R7) and flags truncation,
 and perfgate's self-test proves the regression gate fires on a seeded
 regression while passing the genuine committed bench line."""
 
+import glob
 import json
 import os
 import subprocess
@@ -132,8 +133,12 @@ def test_perfgate_smoke_fails_if_seed_does_not_fire():
 
 def test_perfgate_gates_a_fresh_bench_file(tmp_path):
     """Real mode: an in-band fresh line passes, a regressed one fails, and
-    both accept the RAW bench.py line shape (no driver wrapper)."""
-    genuine = json.load(open(os.path.join(_REPO, "BENCH_r05.json")))["parsed"]
+    both accept the RAW bench.py line shape (no driver wrapper). The genuine
+    sample is the LATEST committed rung — the gate's ref — so the test keeps
+    working as rungs (and newly-gated fields, e.g. the ISSUE-14 restructured
+    step rows r06 introduced) accrete."""
+    latest = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))[-1]
+    genuine = json.load(open(latest))["parsed"]
     good = str(tmp_path / "good.json")
     json.dump(genuine, open(good, "w"))
     proc = _run([os.path.join(_REPO, "tools", "perfgate.py"),

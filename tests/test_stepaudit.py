@@ -45,6 +45,13 @@ def test_stepaudit_smoke_all_variants():
         assert r["recompile"]["compiles"] == 1, (name, r)
     bf16 = result["variants"][stepaudit.BF16_VARIANT]
     assert bf16["dtype"]["dense_f32_vd_free"] is True
+    # the ISSUE-14 end-to-end bf16 chain: no dense f32 [B, D] intermediate
+    # survives in the lowered module (the classic chain's f_pos convert)
+    chain = result["variants"]["rows_gspmd_bf16_chain"]
+    assert chain["dtype"]["dense_f32_bd_free"] is True
+    assert chain["dtype"]["dense_f32_vd_free"] is True
+    # the hot-row slab scan holds donation/one-compile on its 1x1 mesh
+    assert result["variants"]["rows_gspmd_hot"]["mesh"] == [1, 1]
     # the recover-rebuild contract (ISSUE 8): one recovery, twins rebuilt
     # once, exactly one extra compile — 2 total for the whole
     # blowup-and-recover fit

@@ -555,6 +555,25 @@ def main():
                     help="continual_lr_rewarm for the increment")
     ap.add_argument("--continual-iterations", type=int, default=1,
                     help="continual_iterations for the increment")
+    # --- hot-row parity gate (ISSUE 14 / PERF.md §11): the cross-step
+    # hot-row accumulation changes FP rounding order (f32 slab accumulation
+    # + one flush per chunk instead of per-step param-dtype rounding), so it
+    # ships default-off behind THIS measured A/B: two arms on the identical
+    # corpus/seed, the original per-step scatters vs hot_rows, scored on the
+    # same ladder metrics. Documented tolerance: the hot arm fails parity
+    # when its purity@10 drops more than 0.02 absolute below the classic
+    # arm (analogy reported beside it; both rows land in EVAL_RUNS) ---
+    ap.add_argument("--hotrow-ab", action="store_true",
+                    help="train TWO arms on the identical corpus/seed — "
+                         "classic per-step scatters and hot_rows=--hot-rows "
+                         "— and emit one EVAL_RUNS row per arm "
+                         "(hotrow_ab_arm=classic/hot) plus a parity verdict "
+                         "(purity drop > 0.02 absolute fails)")
+    ap.add_argument("--hot-rows", type=int, default=4096,
+                    help="hot_rows for the hot arm of --hotrow-ab")
+    ap.add_argument("--hot-flush-every", type=int, default=0,
+                    help="hot_flush_every for the hot arm (0 = auto: once "
+                         "per dispatch chunk)")
     ap.add_argument("--stab-ab", action="store_true",
                     help="train TWO arms on the identical corpus/seed — the "
                          "unmitigated baseline (all stabilizers off, "
@@ -626,10 +645,13 @@ def main():
                    f"_{args.min_count}") if not args.corpus else
         f"encoded_ext_{args.words}_{args.min_count}")
 
-    def run_arm(stab: dict, save_arrays: bool, arm: str = ""):
+    def run_arm(stab: dict, save_arrays: bool, arm: str = "",
+                arm_field: str = "stab_ab_arm"):
         """Train one configuration and score it; appends the EVAL_RUNS row
         (ground-truth corpora only) carrying the requested stabilizer knobs
-        AND the engaged end state, and returns the result dict."""
+        AND the engaged end state, and returns the result dict. ``arm_field``
+        names the A/B-arm key the row carries (stab_ab_arm / hotrow_ab_arm),
+        so every A/B harness funnels through this one trainer."""
         est = Word2Vec(
             vector_size=args.dim, min_count=args.min_count, window=5,
             negatives=5, negative_pool=args.pool,
@@ -662,7 +684,7 @@ def main():
                 "pairs_per_batch": args.batch, "negative_pool": args.pool,
                 "subsample_ratio": args.subsample, "min_count": args.min_count,
                 "learning_rate": lr, "diverged": type(e).__name__,
-                **stab, **({"stab_ab_arm": arm} if arm else {})}
+                **stab, **({arm_field: arm} if arm else {})}
             if not args.corpus:
                 with open(os.path.join(os.path.dirname(_here),
                                        "EVAL_RUNS.jsonl"), "a") as f:
@@ -704,7 +726,7 @@ def main():
             # (recovery may have backed lr off / engaged the clamp mid-run)
             **stab,
             **getattr(est, "last_run_stats", {}),
-            **({"stab_ab_arm": arm} if arm else {}),
+            **({arm_field: arm} if arm else {}),
         }
         if not args.corpus:
             result.update(evaluate(model.vocab.words,
@@ -820,6 +842,36 @@ def main():
             "vocab_base": v_base, "vocab_grown": inc["vocab_size"],
             "new_words": inc["new_words"],
             "arms": [row_pre, row_post]}))
+        return
+
+    if args.hotrow_ab:
+        # the ISSUE-14 parity gate: classic per-step scatters vs hot-row
+        # accumulation, identical corpus/seed, scored on the same ladder.
+        # Documented tolerance: hot-arm purity@10 more than 0.02 absolute
+        # below the classic arm fails parity (the knob then stays off).
+        r_classic = run_arm(dict(hot_rows=0), save_arrays=False,
+                            arm="classic", arm_field="hotrow_ab_arm")
+        r_hot = run_arm(
+            dict(hot_rows=args.hot_rows,
+                 hot_flush_every=args.hot_flush_every),
+            save_arrays=True, arm="hot", arm_field="hotrow_ab_arm")
+        delta = analogy_delta = None
+        if "purity_at_10" in r_classic and "purity_at_10" in r_hot:
+            delta = round(r_hot["purity_at_10"] - r_classic["purity_at_10"],
+                          4)
+        if ("analogy_accuracy_at_1" in r_classic
+                and "analogy_accuracy_at_1" in r_hot):
+            analogy_delta = round(r_hot["analogy_accuracy_at_1"]
+                                  - r_classic["analogy_accuracy_at_1"], 4)
+        print(json.dumps({
+            "metric": "hotrow_ab",
+            "hot_rows": args.hot_rows,
+            "hot_flush_every": args.hot_flush_every,
+            "purity_delta": delta,
+            "analogy_delta": analogy_delta,
+            "parity_ok": (delta is not None and delta >= -0.02),
+            "parity_rule": "hot purity_at_10 >= classic - 0.02 absolute",
+            "arms": [r_classic, r_hot]}))
         return
 
     stab = dict(max_row_norm=args.max_row_norm, update_clip=args.update_clip,

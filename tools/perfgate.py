@@ -66,6 +66,14 @@ GATED: Dict[str, float] = {
     "v1m_step_pairs_per_sec": 0.15,
     # CBOW step row
     "cbow_examples_per_sec": 0.20,
+    # --- ISSUE-14 restructured step rows (gated only once a rung carries
+    # them — r01-r05 predate the knobs). Same harness/trial structure as
+    # the step rows above, so the same 0.12 band; the hot-row arm adds the
+    # slab-scan/flush structure whose relative cost is geometry-sensitive,
+    # hence the step-row-widest 0.15 ---
+    "step_fused_pairs_per_sec": 0.12,
+    "step_bf16_chain_pairs_per_sec": 0.12,
+    "step_hotrow_pairs_per_sec": 0.15,
 }
 
 # the SERVING trajectory's bands (--kind serve, SERVEBENCH_r*.json from

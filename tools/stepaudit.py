@@ -63,7 +63,14 @@ VARIANTS = ("rows_gspmd", "shard_map", "cols", "cbow_banded",
             # contracts — donation (the touched-row scatter-set must not
             # break aliasing), transfers, dtype (stabilizer norm math is
             # promote(dtype, f32) — no f64 creep), one-compile
-            "rows_gspmd_stab", "shard_map_stab")
+            "rows_gspmd_stab", "shard_map_stab",
+            # ISSUE-14 step restructurings: the fused coefficient chain, the
+            # cross-step hot-row slab scan (segmented scans + prefix flush
+            # must keep donation/transfers/one-compile), and the end-to-end
+            # bf16 chain twin, which additionally carries the NEW dtype
+            # contract — no dense f32 [B, D] intermediate in the lowered
+            # bf16 module (dense_f32_bd_free)
+            "rows_gspmd_fused", "rows_gspmd_hot", "rows_gspmd_bf16_chain")
 # the bf16 twin of the rows step carries the dense-f32 check (contract c)
 BF16_VARIANT = "rows_gspmd_bf16"
 
@@ -104,6 +111,14 @@ def _variant_config_kwargs(variant: str) -> dict:
     if variant == "shard_map_stab":
         return dict(step_lowering="shard_map", negative_pool=16,
                     max_row_norm=50.0, update_clip=0.5, row_l2=1e-4)
+    if variant == "rows_gspmd_fused":
+        return dict(negative_pool=16, fused_logits=True)
+    if variant == "rows_gspmd_hot":
+        return dict(negative_pool=16, hot_rows=8, hot_flush_every=2)
+    if variant == "rows_gspmd_bf16_chain":
+        return dict(negative_pool=16, param_dtype="bfloat16",
+                    compute_dtype="bfloat16", logits_dtype="bfloat16",
+                    fused_logits=True, bf16_chain=True)
     if variant == BF16_VARIANT:
         return dict(param_dtype="bfloat16", compute_dtype="bfloat16")
     raise ValueError(f"unknown variant {variant!r}")
@@ -163,6 +178,11 @@ def audit_variant(variant: str, mesh_shape, geom: dict) -> dict:
     from glint_word2vec_tpu.train.trainer import Trainer
 
     vocab, enc = _toy_problem(geom)
+    if variant == "rows_gspmd_hot":
+        # the hot-row restructuring is the single-chip path by contract
+        # (config refuses multi-shard meshes, the trainer refuses
+        # multi-device plans — PERF.md §11); audit it where it runs
+        mesh_shape = (1, 1)
     plan = make_mesh(*mesh_shape)
     cfg = Word2VecConfig(
         vector_size=geom["d"], min_count=1, pairs_per_batch=geom["b"],
@@ -205,6 +225,15 @@ def audit_variant(variant: str, mesh_shape, geom: dict) -> dict:
             dense = f"tensor<{trainer.padded_vocab}x{trainer.padded_dim}xf32>"
             dtype["dense_f32_vd_free"] = dense not in lowered_text
             dtype["ok"] = dtype["ok"] and dtype["dense_f32_vd_free"]
+        if cfg.bf16_chain:
+            # the ISSUE-14 dtype-contract row: the end-to-end bf16 chain
+            # must leave NO dense f32 [B, D] intermediate in the lowered
+            # module (the classic chain's f_pos path converts the [B, D]
+            # product to f32 before its reduce; the chain accumulates in
+            # the dot via preferred_element_type instead)
+            dense_bd = f"tensor<{geom['b']}x{trainer.padded_dim}xf32>"
+            dtype["dense_f32_bd_free"] = dense_bd not in lowered_text
+            dtype["ok"] = dtype["ok"] and dtype["dense_f32_bd_free"]
 
         # (a) donation: input/output aliasing in the compiled artifact
         donation = donation_summary(lowered.compile().as_text())
