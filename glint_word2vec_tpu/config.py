@@ -586,6 +586,70 @@ class Word2VecConfig:
                                     # telemetry_path is set (the dump path
                                     # derives from it)
 
+    # --- preemption + training supervisor (docs/robustness.md §supervisor;
+    # train/supervisor.py, tools/train_run.py). checkpoint_on_preempt /
+    # preempt_deadline_s / peer_beacon_s are read by the trainer's SIGNAL
+    # and round-bookkeeping paths only (host-side, after the dispatch is
+    # staged); the supervisor_* knobs are read by the SUPERVISOR process,
+    # never by the trainer — all dispatch-inert ---
+    checkpoint_on_preempt: bool = False  # True: a SIGTERM during fit() no
+                                    # longer kills the run on the spot —
+                                    # the handler records a deadline and
+                                    # the trainer finishes the in-flight
+                                    # dispatch, drains the carry, runs the
+                                    # nonfinite/norm guard, and writes an
+                                    # EMERGENCY checkpoint through the
+                                    # normal digest-verified atomic save
+                                    # path, then exits resumable (run_end
+                                    # status "preempted", rc = -SIGTERM).
+                                    # Past the deadline (or if the guard
+                                    # refuses the carry) it degrades to
+                                    # the blackbox-only dump — never a
+                                    # torn or unverified save. False
+                                    # (default): dump-and-die, the
+                                    # pre-supervisor behavior
+    preempt_deadline_s: float = 30.0  # budget between the first SIGTERM
+                                    # and the forced exit: the emergency
+                                    # save only STARTS while inside it
+                                    # (a TPU preemption sends SIGKILL
+                                    # ~30s after the warning; k8s default
+                                    # grace is 30s)
+    peer_beacon_s: float = 0.0      # > 0 (multi-process sharded fits):
+                                    # each process touches a liveness
+                                    # beacon beside the checkpoint dir
+                                    # this often and checks its peers'
+                                    # before each allgather round. A peer
+                                    # stale past 6x this aborts the fit
+                                    # cleanly (PeerDeathError) instead of
+                                    # hanging in the collective rendezvous
+                                    # forever; a process WEDGED inside the
+                                    # collective hard-exits (rc 43) from
+                                    # the beacon watcher thread at 12x.
+                                    # 0 (default) = off, zero cost
+    supervisor_stall_s: float = 300.0  # hang watchdog: no step advance
+                                    # observed (telemetry tail /
+                                    # status.json) within this many
+                                    # seconds => the supervisor captures a
+                                    # diagnostic (SIGTERM = blackbox dump,
+                                    # then SIGKILL), counts a failure, and
+                                    # resumes from the last valid
+                                    # checkpoint
+    supervisor_max_restarts: int = 8  # total restart budget per
+                                    # TrainingSupervisor.run(); exhaustion
+                                    # halts with a machine-readable
+                                    # verdict — never an unbounded
+                                    # restart loop
+    supervisor_loop_window: int = 3  # crash-loop quarantine rule: this
+                                    # many CONSECUTIVE failures with the
+                                    # same signature (exception/signal
+                                    # type + same step, +- one dispatch
+                                    # chunk) classify a deterministic
+                                    # crash-loop — escalate per the
+                                    # documented ladder (engage
+                                    # stabilizers / lr backoff, then halt
+                                    # quarantined) instead of restarting
+                                    # forever
+
     # --- serving tier (docs/serving.md; serve/ — read by the SERVING
     # process, never by the trainer: dispatch-inert by construction. The
     # knobs travel with the checkpoint like every other field, so a
@@ -1208,6 +1272,28 @@ class Word2VecConfig:
         if self.blackbox_ring <= 0:
             raise ValueError(
                 f"blackbox_ring must be positive but got {self.blackbox_ring}")
+        if self.preempt_deadline_s <= 0:
+            raise ValueError(
+                f"preempt_deadline_s must be positive "
+                f"but got {self.preempt_deadline_s}")
+        if self.peer_beacon_s < 0:
+            raise ValueError(
+                f"peer_beacon_s must be nonnegative (0 = off) "
+                f"but got {self.peer_beacon_s}")
+        if self.supervisor_stall_s <= 0:
+            raise ValueError(
+                f"supervisor_stall_s must be positive "
+                f"but got {self.supervisor_stall_s}")
+        if self.supervisor_max_restarts < 0:
+            raise ValueError(
+                f"supervisor_max_restarts must be nonnegative "
+                f"but got {self.supervisor_max_restarts}")
+        if self.supervisor_loop_window < 2:
+            # 1 would classify every SECOND failure as a deterministic loop
+            # (a single repeat proves nothing about determinism)
+            raise ValueError(
+                f"supervisor_loop_window must be >= 2 "
+                f"but got {self.supervisor_loop_window}")
         if self.serve_max_batch <= 0:
             raise ValueError(
                 f"serve_max_batch must be positive "

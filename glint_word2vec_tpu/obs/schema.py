@@ -77,7 +77,8 @@ KINDS: Dict[str, Dict[str, tuple]] = {
     },
     "run_end": {
         "run_id": (str,),
-        "status": (str,),        # "ok" | "error"
+        "status": (str,),        # "ok" | "error" | "preempted" (emergency-
+                                 # checkpoint exit — train/supervisor.py)
         "steps": (int,),
         "pairs_trained": _NUM,
         "host_wait_s_total": _NUM,
@@ -199,6 +200,53 @@ KINDS: Dict[str, Dict[str, tuple]] = {
         "new_words": (int,),
         "words": (int,),         # tail tokens trained
         "train_seconds": _NUM,
+    },
+    # --- training-supervisor record kinds (train/supervisor.py,
+    # docs/robustness.md; ADDITIVE under the schema evolution rule) ---
+    # the trainer's own last word under a preemption: emitted by
+    # _preempt_exit right before run_end status="preempted", carrying
+    # whether the emergency save made the deadline and how many steps
+    # separate the carry from the last published checkpoint (the
+    # progress-lost-since-last-save the supervisor and run_report report)
+    "preempt": {
+        "step": (int,),
+        "saved": (bool,),        # emergency checkpoint published + verified
+        "checkpoint": (str,),
+        "deadline_s": _NUM,      # config.preempt_deadline_s
+        "steps_since_save": (int,),  # 0 when saved — nothing was lost
+    },
+    # supervisor lifecycle: one sink per supervisor, distinct from the
+    # child fits' sinks (each attempt writes its own run_* bracket)
+    "supervisor_start": {
+        "commands": (int,),      # gang size (1 = single-process fit)
+        "max_restarts": (int,),
+        "stall_s": _NUM,
+    },
+    "supervisor_exit": {         # one per child-process death, any cause
+        "attempt": (int,),
+        "rc": (int,),            # negative = killed by that signal
+        "cls": (str,),           # ok|preempt|stall|crash|peer-death
+        "step": (int,),          # last observed telemetry step
+    },
+    "supervisor_restart": {
+        "attempt": (int,),       # the attempt ABOUT to start
+        "backoff_s": _NUM,       # decorrelated-jitter sleep taken first
+        "resume_step": (int,),   # step of the checkpoint resumed from
+    },
+    "supervisor_stall": {
+        "attempt": (int,),
+        "last_step": (int,),
+        "stalled_s": _NUM,       # silence observed when the watchdog fired
+    },
+    "supervisor_quarantine": {
+        "signature": (str,),     # the repeated (cls, step-bucket) signature
+        "attempts": (int,),
+        "ladder_stage": (int,),  # 1 = mitigations engaged, 2 = halted
+    },
+    "supervisor_end": {
+        "status": (str,),        # ok | quarantined | gave-up
+        "attempts": (int,),
+        "final_step": (int,),
     },
 }
 

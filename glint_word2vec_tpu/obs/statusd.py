@@ -187,6 +187,25 @@ def fleet_prometheus_text(snap: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def supervisor_prometheus_text(snap: dict) -> str:
+    """Render a SUPERVISOR snapshot (train.supervisor.TrainingSupervisor
+    .status_snapshot) in Prometheus text format — the
+    ``glint_supervisor_*`` names (stable contract, docs/robustness.md
+    §supervisor): restart/stall/preempt counters, the escalation-ladder
+    stage, the quarantine latch, and the child gang's last observed step."""
+    lines: list = []
+
+    def gauge(name: str, value, labels: str = "") -> None:
+        _gauge(lines, name, value, labels)
+
+    gauge("glint_supervisor_up", snap.get("up"))
+    for field in ("attempts", "restarts", "stalls", "preempts"):
+        gauge(f"glint_supervisor_{field}_total", snap.get(field))
+    for field in ("ladder_stage", "quarantined", "last_step", "child_up"):
+        gauge(f"glint_supervisor_{field}", snap.get(field))
+    return "\n".join(lines) + "\n"
+
+
 class _Handler(BaseHTTPRequestHandler):
     # set per-server via the factory in StatusServer.start
     snapshot_fn: Callable[[], dict]

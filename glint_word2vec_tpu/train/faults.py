@@ -33,6 +33,14 @@ tests; all off by default and zero-cost when off):
 - ``GLINT_FAULT_NAN_AT_STEP=N`` — the trainer poisons one param entry with NaN
   at the first round whose global step reaches N (once), exercising the
   non-finite guardrail's halt/rollback policies.
+- ``GLINT_FAULT_STALL_AT_STEP=N`` (with optional ``GLINT_FAULT_STALL_S``,
+  default 30) — the trainer sleeps ``stall_s`` seconds INSIDE the round that
+  reaches global step >= N (once): a deterministic in-step hang with no step
+  advance and no heartbeat, the signature the supervisor's stall watchdog
+  (train/supervisor.py, ``config.supervisor_stall_s``) must detect and kill.
+  The sleep is sliced so an intervening signal handler (the SIGTERM blackbox
+  dump) still runs promptly; the stalled round itself never finishes early —
+  exactly a wedged collective/IO from the watchdog's point of view.
 - ``GLINT_FAULT_SCALE_PARAMS_AT_STEP=N`` (with optional
   ``GLINT_FAULT_SCALE_PARAMS_FACTOR``, default 1e6, and
   ``GLINT_FAULT_SCALE_PARAMS_TIMES``, default 1) — the trainer multiplies
@@ -101,6 +109,11 @@ class FaultPlan:
                                    # blowup: the norm watchdog's channel, a
                                    # state the nan_at_step injection cannot
                                    # produce (isfinite stays True throughout)
+    stall_at_step: int = 0         # sleep stall_s inside the round reaching
+                                   # this global step (once) — the
+                                   # no-progress hang the supervisor's
+                                   # stall watchdog detects; 0 = off
+    stall_s: float = 30.0
     scale_params_factor: float = 1e6
     scale_params_times: int = 1    # how many rounds the scale injection
                                    # fires (each subsequent qualifying round
@@ -163,6 +176,8 @@ def active_plan() -> FaultPlan:
         corrupt_checkpoint_bytes=_env_int("GLINT_FAULT_CORRUPT_CKPT_BYTES"),
         fail_ingest_first_n=_env_int("GLINT_FAULT_FAIL_INGEST_FIRST_N"),
         nan_at_step=_env_int("GLINT_FAULT_NAN_AT_STEP"),
+        stall_at_step=_env_int("GLINT_FAULT_STALL_AT_STEP"),
+        stall_s=_env_float("GLINT_FAULT_STALL_S", 30.0),
         scale_params_at_step=_env_int("GLINT_FAULT_SCALE_PARAMS_AT_STEP"),
         scale_params_factor=_env_float(
             "GLINT_FAULT_SCALE_PARAMS_FACTOR", 1e6),
@@ -229,6 +244,32 @@ def take_nan_injection(global_step: int) -> bool:
     logger.warning("injecting NaN into params at global step %d (scripted "
                    "nan_at_step=%d)", global_step, p.nan_at_step)
     return True
+
+
+def maybe_stall(global_step: int) -> float:
+    """Trainer hook: sleep ``stall_s`` seconds at the first round whose
+    global step reaches the scripted ``stall_at_step`` (once per process).
+    Returns the scripted stall duration (0.0 = did not fire). The sleep is
+    sliced into sub-second waits so a signal handler interrupting it (the
+    SIGTERM blackbox-dump hook) returns to the stall, not past it — the
+    round stays wedged for the full duration, like a real hung collective,
+    and only SIGKILL (the supervisor's escalation) ends it early."""
+    p = active_plan()
+    if not p.stall_at_step or global_step < p.stall_at_step:
+        return 0.0
+    if _counters.get("stall_done"):
+        return 0.0
+    _counters["stall_done"] = True
+    logger.warning("injecting %.1fs in-step stall at global step %d "
+                   "(scripted stall_at_step=%d)", p.stall_s, global_step,
+                   p.stall_at_step)
+    end = time.monotonic() + p.stall_s
+    while True:
+        left = end - time.monotonic()
+        if left <= 0:
+            break
+        time.sleep(min(left, 0.25))
+    return float(p.stall_s)
 
 
 def take_scale_injection(global_step: int) -> float:

@@ -1385,6 +1385,10 @@ class Trainer:
         source (every non-continual fit), behavior unchanged.
         """
         cfg = self.config
+        # where this fit publishes checkpoints — the SIGTERM preemption hook
+        # (config.checkpoint_on_preempt) drains its emergency save here, so
+        # the handler needs it before any fit path's bookkeeping runs
+        self._active_checkpoint_path = checkpoint_path
         from glint_word2vec_tpu.data.pipeline import expected_kept_words
         train_words = expected_kept_words(
             self.vocab.counts, self.vocab.train_words_count, cfg.subsample_ratio)
@@ -2241,6 +2245,7 @@ class Trainer:
         pairs_arrays: List[jax.Array] = []
         dropped_arrays: List[jax.Array] = []
         self._start_run_bookkeeping()
+        beacons = self._start_peer_beacons(checkpoint_path)
 
         def round_stream():
             from glint_word2vec_tpu.parallel.distributed import (
@@ -2296,6 +2301,11 @@ class Trainer:
 
             pending = start_gather()
             while True:
+                if beacons is not None:
+                    # see _fit_sharded: a dead peer's collective never comes;
+                    # check (a file stat — safe on this producer thread)
+                    # before blocking on the fetch
+                    beacons.check_or_raise()
                 t0 = time.perf_counter()
                 with self._tracer.span("allgather_fetch"):
                     g = allgather_fetch(pending)  # leading [S] process axis
@@ -2438,6 +2448,8 @@ class Trainer:
             raise
         finally:
             self._stop_profiler()
+            if beacons is not None:
+                beacons.stop()
             closer = getattr(rounds, "close", None)
             if closer is not None:
                 closer()
@@ -2548,6 +2560,14 @@ class Trainer:
         # describes exactly one fit.
         import os
         self._run_ended = False
+        # preemption-deadline state (config.checkpoint_on_preempt): the
+        # SIGTERM handler only ARMS the deadline; _finish_round's tail
+        # drains it. Reset per fit so a resumed run re-arms cleanly.
+        self._preempt_deadline = None
+        self._preempt_signum = 0
+        # last step a checkpoint actually published at — the preempt record's
+        # progress-lost-since-last-save denominator
+        self._last_save_step = int(self.global_step)
         self._run_id = f"{os.getpid()}-{int(time.time())}-{self.global_step}"
         observing = self._telemetry is not None or self.config.status_port > 0
         self._tracer.configure(enabled=observing)
@@ -2873,8 +2893,12 @@ class Trainer:
         SIGINT (delivered as KeyboardInterrupt, which the fit paths' abort
         handler already turns into a dump), it would otherwise kill the
         process with no artifact. Main-thread only (the signal module's
-        rule); restored by _teardown_run_inspection."""
-        if self._blackbox is None:
+        rule); restored by _teardown_run_inspection.
+
+        Also armed — blackbox or not — when config.checkpoint_on_preempt
+        asks the fit to answer a preemption with an emergency checkpoint
+        instead of just dying (docs/robustness.md)."""
+        if self._blackbox is None and not self.config.checkpoint_on_preempt:
             return
         import signal
         try:
@@ -2896,6 +2920,30 @@ class Trainer:
         if self._blackbox is not None:
             self._blackbox.dump(FlightRecorder.signal_cause(signum),
                                 extra=self._dump_context())
+        # preemption-deadline checkpointing (config.checkpoint_on_preempt):
+        # a handler can interrupt arbitrary host code — mid-dispatch, inside
+        # a collective, halfway through a save — where launching the
+        # emergency save HERE could deadlock or tear. So the handler only
+        # ARMS a deadline and returns; the in-flight dispatch finishes
+        # naturally and _finish_round's tail (the first point where no
+        # collective is in flight) drains the carry through the normal
+        # digest-verified save path via _preempt_exit. First signal wins:
+        # a repeat TERM while armed just returns (the deadline is already
+        # running); one arriving after the run ended falls through to the
+        # die-now path below.
+        if (self.config.checkpoint_on_preempt
+                and not getattr(self, "_run_ended", True)
+                and getattr(self, "_active_checkpoint_path", None)):
+            if getattr(self, "_preempt_deadline", None) is None:
+                self._preempt_deadline = (
+                    time.monotonic() + self.config.preempt_deadline_s)
+                self._preempt_signum = int(signum)
+                logger.warning(
+                    "SIGTERM at step %d: preemption deadline armed "
+                    "(%.1fs) — finishing in-flight dispatch, then "
+                    "emergency checkpoint", self.global_step,
+                    self.config.preempt_deadline_s)
+            return
         # _end_run's teardown RESTORES the pre-fit disposition (it must run
         # before the re-raise, not after — nothing after os.kill runs under
         # the default disposition), so the re-raised signal is delivered
@@ -3086,6 +3134,7 @@ class Trainer:
                     lambda x: x * f.astype(x.dtype), p))
             self.params = self._scale_fn(self.params, jnp.float32(scale))
         faults.crash_at_step(self.global_step)
+        faults.maybe_stall(self.global_step)
 
         # jax.profiler window (config.profile_steps): stop the trace once the
         # configured number of steps completed after fit start
@@ -3193,6 +3242,88 @@ class Trainer:
             # full [V, D] reduction + sync per coincident round is the probe
             # cost this method's single-probe rule exists to avoid
             self.save_checkpoint(checkpoint_path, _channels=channels)
+
+        # preemption drain (config.checkpoint_on_preempt): the SIGTERM
+        # handler only ARMED _preempt_deadline — this is the first point
+        # after it where the in-flight dispatch has completed and no
+        # collective is mid-flight, so the emergency save can run the
+        # normal atomic path. Never returns.
+        if getattr(self, "_preempt_deadline", None) is not None:
+            self._preempt_exit(checkpoint_path, channels)
+
+    def _preempt_exit(self, checkpoint_path: Optional[str],
+                      channels: Optional[dict]) -> None:
+        """The deferred half of the SIGTERM preemption path (_on_sigterm
+        armed it; _finish_round's tail calls it): within the remaining
+        deadline budget, drain the carry through the normal digest-verified
+        atomic save (save_checkpoint's np.asarray blocks on the async
+        dispatch, and its nonfinite/norm guard still vetoes a blown-up
+        carry — never a torn or unverified emergency save; the atomic
+        protocol leaves the previous verified checkpoint in place on any
+        failure). Then the ``preempt`` telemetry record, run_end with
+        status="preempted", a final flight-recorder dump whose event ring
+        carries both terminal records, and the re-raised signal under the
+        restored disposition so the sender sees the exit code it expects
+        (rc = -SIGTERM). Never returns."""
+        import os
+        signum = self._preempt_signum or 15
+        remaining = self._preempt_deadline - time.monotonic()
+        steps_since_save = int(self.global_step) - int(self._last_save_step)
+        saved = False
+        if checkpoint_path and steps_since_save == 0:
+            # a ckpt_due save already published at this very step (the
+            # coincident round) — zero progress to lose, nothing to rewrite
+            saved = True
+        elif checkpoint_path and remaining > 0:
+            try:
+                self.save_checkpoint(checkpoint_path, _channels=channels)
+                saved = True
+            except BaseException as e:  # noqa: BLE001 — the guard raising
+                # on a non-finite carry, or I/O dying under eviction
+                # pressure: fall back to the blackbox-only exit
+                logger.warning(
+                    "emergency checkpoint failed (%s); falling back to "
+                    "blackbox-only exit", e)
+        else:
+            logger.warning(
+                "preempt deadline missed by %.1fs — blackbox-only exit",
+                max(-remaining, 0.0))
+        self._emit("preempt", step=int(self.global_step), saved=saved,
+                   checkpoint=checkpoint_path or "",
+                   deadline_s=float(self.config.preempt_deadline_s),
+                   steps_since_save=0 if saved else steps_since_save)
+        self._end_run("preempted")
+        if self._blackbox is not None:
+            from glint_word2vec_tpu.obs.blackbox import FlightRecorder
+            self._blackbox.dump(FlightRecorder.signal_cause(signum),
+                                extra=self._dump_context())
+        os.kill(os.getpid(), signum)
+
+    def _start_peer_beacons(self, checkpoint_path: Optional[str]):
+        """Arm the per-process liveness beacons of a multi-process fit
+        (train/supervisor.py BeaconBoard; docs/robustness.md): each process
+        heartbeats a tiny file beside the checkpoint path, and the
+        main-thread ``check_or_raise`` before every allgather turns a dead
+        peer into a clean PeerDeathError abort instead of an eternal
+        collective hang (the board's watcher thread hard-exits the process
+        if it IS already wedged inside the collective). Returns None when
+        off (``peer_beacon_s=0``), when single-process, or when there is no
+        checkpoint path to anchor the beacon directory to."""
+        import os
+        if self.config.peer_beacon_s <= 0 or not checkpoint_path:
+            return None
+        import jax
+        if jax.process_count() <= 1:
+            return None
+        from glint_word2vec_tpu.train.supervisor import BeaconBoard
+        board = BeaconBoard(
+            os.path.join(os.path.dirname(os.path.abspath(checkpoint_path)),
+                         "beacons"),
+            process_index=jax.process_index(),
+            num_processes=jax.process_count(),
+            interval_s=self.config.peer_beacon_s)
+        board.start()
+        return board
 
     def _fit_sharded(
         self,
@@ -3358,6 +3489,7 @@ class Trainer:
         cur_iter, cur_batches = start_iter, skip
         exhausted = False
         self._start_run_bookkeeping()
+        beacons = self._start_peer_beacons(checkpoint_path)
         zero_arrays = empty_feed()
         try:
             while True:
@@ -3376,6 +3508,11 @@ class Trainer:
                     cur_iter = local["iteration"]
                     cur_batches = local["batches_done"]
 
+                if beacons is not None:
+                    # a dead peer never reaches its allgather — entering ours
+                    # would hang forever; the beacon check converts that into
+                    # a clean abort the supervisor restarts the gang from
+                    beacons.check_or_raise()
                 t0 = time.perf_counter()
                 g = multihost_utils.process_allgather({
                     **local["arrays"],
@@ -3446,6 +3583,8 @@ class Trainer:
             raise
         finally:
             self._stop_profiler()
+            if beacons is not None:
+                beacons.stop()
             closer = getattr(chunks, "close", None)
             if closer is not None:
                 closer()
@@ -3514,6 +3653,8 @@ class Trainer:
                 np.asarray(p.syn0), np.asarray(p.syn1),
                 self.config, self.state, extra_metadata=extra)
         logger.info("checkpoint saved to %s at step %d", path, self.global_step)
+        # the preempt record's progress-lost denominator (docs/robustness.md)
+        self._last_save_step = int(self.global_step)
         if self._telemetry is not None or self._blackbox is not None:
             # the publish-side correlation record (obs/trace.py): carries
             # the freshly-written checkpoint's publish_sig — the SAME

@@ -59,12 +59,22 @@ Every fault is deterministic (train/faults.py) — no sleep/kill-timing races:
    with every reload issued only to a drained replica.
 10. **flaky-ingest** — the first N ingest I/O attempts raise; the bounded
     exponential-backoff wrapper in ``data/`` must absorb them.
+11. **train-preempt / train-stall / train-crashloop** — the training
+    SUPERVISOR under scripted faults (ISSUE 16, docs/robustness.md
+    §supervisor, delegating to tools/train_run.py): a SIGTERM'd fit
+    emergency-checkpoints within its preemption deadline and resumes to
+    match an uninterrupted twin's purity gate; an injected in-step hang
+    is detected within 2x the stall horizon, diagnosed (flight-recorder
+    dump), killed, and resumed; a deterministic every-attempt crash walks
+    the escalation ladder and is quarantined with a machine-readable
+    verdict in bounded attempts.
 
 Usage::
 
     python tools/chaos_run.py           # moderate sizes
     python tools/chaos_run.py --smoke   # small + fast (wired into tier-1 tests)
     python tools/chaos_run.py --only serve-reload   # one phase (CI serving job)
+    python tools/chaos_run.py --list    # print available phase names
 
 Exit code 0 iff every phase passed.
 """
@@ -640,6 +650,45 @@ def phase_fleet_kill(workdir: str, n_sentences: int) -> str:
     return ""
 
 
+def _phase_supervisor(drill, workdir: str, n_sentences: int) -> str:
+    """Shared wrapper for the three supervisor drills (ISSUE 16,
+    docs/robustness.md §supervisor) — each delegates to the training
+    driver's drill (tools/train_run.py, the same assertions CI's
+    supervisor job runs standalone) and reports its first broken
+    invariant."""
+    os.makedirs(workdir, exist_ok=True)
+    try:
+        drill(workdir, n_sentences)
+    except AssertionError as e:
+        return str(e)
+    except Exception as e:  # noqa: BLE001 — any raise is the failure
+        return f"{type(e).__name__}: {e}"
+    return ""
+
+
+def phase_train_preempt(workdir: str, n_sentences: int) -> str:
+    """A SIGTERM'd supervised fit must emergency-checkpoint within its
+    preemption deadline (losing at most one dispatch chunk), get restarted
+    from the verified save, and finish matching an uninterrupted twin."""
+    from tools.train_run import run_preempt_drill
+    return _phase_supervisor(run_preempt_drill, workdir, n_sentences)
+
+
+def phase_train_stall(workdir: str, n_sentences: int) -> str:
+    """An injected in-step hang must be detected within 2x the stall
+    horizon, diagnosed (SIGTERM flight-recorder dump, then SIGKILL), and
+    the run resumed to completion."""
+    from tools.train_run import run_stall_drill
+    return _phase_supervisor(run_stall_drill, workdir, n_sentences)
+
+
+def phase_train_crashloop(workdir: str, n_sentences: int) -> str:
+    """A deterministic every-attempt crash must walk the escalation ladder
+    and quarantine with a machine-readable verdict in bounded attempts."""
+    from tools.train_run import run_crashloop_drill
+    return _phase_supervisor(run_crashloop_drill, workdir, n_sentences)
+
+
 def phase_flaky_ingest(workdir: str) -> str:
     from glint_word2vec_tpu.data.corpus import encode_corpus
     from glint_word2vec_tpu.data.vocab import build_vocab
@@ -671,6 +720,8 @@ def main() -> int:
     ap.add_argument("--only", default="",
                     help="comma-separated phase names to run (default: all) "
                          "— the CI serving job runs --only serve-reload")
+    ap.add_argument("--list", action="store_true",
+                    help="print available phase names and exit")
     args = ap.parse_args()
 
     n_sentences = args.sentences or (300 if args.smoke else 1500)
@@ -704,12 +755,27 @@ def main() -> int:
                                   min(n_sentences, 300))),
         ("flaky-ingest",
          lambda: phase_flaky_ingest(os.path.join(workdir, "p4"))),
+        ("train-preempt",
+         lambda: phase_train_preempt(os.path.join(workdir, "p9"),
+                                     min(n_sentences, 200))),
+        ("train-stall",
+         lambda: phase_train_stall(os.path.join(workdir, "p10"),
+                                   min(n_sentences, 200))),
+        ("train-crashloop",
+         lambda: phase_train_crashloop(os.path.join(workdir, "p11"),
+                                       min(n_sentences, 200))),
     ]
+    if args.list:
+        for name, _ in phases:
+            print(name)
+        return 0
     if args.only:
         want = {p.strip() for p in args.only.split(",") if p.strip()}
-        unknown = want - {name for name, _ in phases}
+        names = [name for name, _ in phases]
+        unknown = want - set(names)
         if unknown:
-            print(f"[chaos] unknown phase(s): {sorted(unknown)}", flush=True)
+            print(f"[chaos] unknown phase(s): {sorted(unknown)} — "
+                  f"available: {', '.join(names)}", flush=True)
             return 2
         phases = [(name, fn) for name, fn in phases if name in want]
     failures = 0

@@ -130,6 +130,19 @@ KNOBS = {k.name: k for k in [
               "serving thread); the statusd contract incl. zero-cost-when-"
               "off is tested in tests/test_statusd.py"),
     _K("blackbox_ring", (1, 256), invalid=0, dispatch_inert=True),
+    # --- preemption + training-supervisor knobs (train/supervisor.py,
+    # docs/robustness.md §supervisor): checkpoint_on_preempt/
+    # preempt_deadline_s/peer_beacon_s are read only by the trainer's
+    # signal + round-bookkeeping paths (host-side, after dispatch is
+    # staged); the supervisor_* knobs only by the supervisor process —
+    # dispatch-inert by construction ---
+    _K("checkpoint_on_preempt", (False, True), dispatch_inert=True),
+    _K("preempt_deadline_s", (1.0, 30.0), invalid=0.0, dispatch_inert=True),
+    _K("peer_beacon_s", (0.0, 0.5, 5.0), invalid=-1.0, dispatch_inert=True),
+    _K("supervisor_stall_s", (5.0, 300.0), invalid=0.0, dispatch_inert=True),
+    _K("supervisor_max_restarts", (0, 2, 8), invalid=-1,
+       dispatch_inert=True),
+    _K("supervisor_loop_window", (2, 3), invalid=1, dispatch_inert=True),
     # --- serving-tier knobs (serve/, docs/serving.md): read only by the
     # serving process (EmbeddingService), never by trainer construction or
     # dispatch — dispatch-inert by construction ---

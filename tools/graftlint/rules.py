@@ -79,6 +79,13 @@ class R1ThreadPools:
         # determinism contract R1 guards is untouched
         ("glint_word2vec_tpu/serve/fleet.py", "SubprocessReplica.start"),
         ("glint_word2vec_tpu/serve/fleet.py", "FleetRouter.__init__"),
+        # the peer-liveness BEACON writer (docs/robustness.md §supervisor,
+        # ISSUE 16): one daemon thread per sharded-fit process touching a
+        # liveness file every peer_beacon_s and watchdogging the main
+        # thread — touches no training data, orders nothing; it exists
+        # precisely for when the main thread is wedged in a dead peer's
+        # collective and nothing deterministic can run at all
+        ("glint_word2vec_tpu/train/supervisor.py", "BeaconBoard.start"),
     }
 
     def applies(self, path: str) -> bool:

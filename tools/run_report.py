@@ -30,7 +30,11 @@ Usage::
 Exit code 0 iff every log parsed and (when the run ended) ended "ok"; a
 truncated log (no run_end / serve_end / fleet_end bracket) reports
 ``"status": "truncated"`` and exits 1 — a remote driver can alarm on
-exactly that. In ``--log`` mode each log's ``<log>.blackbox.json`` dump is
+exactly that. A deadline-checkpointed preemption reports
+``"status": "preempted"`` (distinct from both: the run was ASKED to die
+and closed its bracket first) with a ``"preempt"`` block carrying
+steps-saved vs steps-lost; it still exits 1 — resuming is the
+supervisor's job, not a clean end. In ``--log`` mode each log's ``<log>.blackbox.json`` dump is
 folded in automatically when present (a dump next to a truncated serving
 log is the expected SIGTERM shape, not an error).
 """
@@ -92,6 +96,7 @@ def summarize(paths: List[str], blackbox: str = "",
     run_end: Optional[dict] = None
     watchdog = 0
     recoveries: List[dict] = []
+    preempt: Optional[dict] = None
     schema_ok = True
     schema_errors: List[str] = []
     for path in paths:
@@ -123,6 +128,8 @@ def summarize(paths: List[str], blackbox: str = "",
                     watchdog += 1
                 elif kind == "recovery":
                     recoveries.append(r)
+                elif kind == "preempt":
+                    preempt = r
 
     pps = sorted(float(h["pairs_per_sec"]) for h in heartbeats
                  if h.get("pairs_per_sec"))
@@ -174,6 +181,22 @@ def summarize(paths: List[str], blackbox: str = "",
         "norms": {m: t for m in ("syn0", "syn1")
                   if (t := _norm_track(m))} or None,
     }
+    if status == "preempted" and preempt is not None:
+        # a deadline-checkpointed preemption (config.checkpoint_on_preempt,
+        # docs/robustness.md §supervisor): distinct from "truncated" (died
+        # with no end bracket) and "error" (failed) — the run was ASKED to
+        # die and published what it could first. steps_lost is what the
+        # supervisor re-trains after resume: 0 when the emergency save made
+        # the deadline, else the gap back to the last periodic checkpoint.
+        lost = 0 if preempt.get("saved") else int(
+            preempt.get("steps_since_save") or 0)
+        report["preempt"] = {
+            "saved": bool(preempt.get("saved")),
+            "step": preempt.get("step"),
+            "steps_saved": int(preempt.get("step") or 0) - lost,
+            "steps_lost": lost,
+            "checkpoint": preempt.get("checkpoint"),
+        }
     if blackbox:
         bb = validate_blackbox_file(blackbox)
         report["blackbox"] = {"path": blackbox, "valid": bb["ok"],
