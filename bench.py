@@ -679,6 +679,14 @@ def main() -> None:
         # per-row min/median/max ms across the 3 interleaved trials (VERDICT
         # r8 item 4): the spread that qualifies every step number above
         "step_trials_ms": {k: rows[k][2] for k in rows},
+        # flat per-row scalars (ADDITIVE beside the nested spread dict): one
+        # `step_<row>_pairs_per_sec` + `step_<row>_step_ms` pair per step row
+        # above, so tools/perfgate.py gates every row by a stable top-level
+        # name instead of digging step_trials_ms (rows absent this run —
+        # e.g. a failed restructured arm — simply emit no key, and the gate
+        # skips metrics missing from the rung)
+        **{f"step_{k}_pairs_per_sec": round(rows[k][0]) for k in rows},
+        **{f"step_{k}_step_ms": rows[k][2]["ms_median"] for k in rows},
         "v1m_step_trials_ms": scale.get("step_trials_ms"),
         "e2e_pairs_per_sec": round(e2e_pps) if e2e_pps else None,
         "e2e_feed": e2e_best_key,

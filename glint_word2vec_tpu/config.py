@@ -122,6 +122,29 @@ class Word2VecConfig:
                                     # construction). GSPMD stays the default until a
                                     # hardware A/B lands (the audited collective
                                     # profile is the evidence so far, PERF.md §7)
+    sync_every: int = 1             # local-SGD merge cadence (docs/sharding.md
+                                    # §Local-SGD): 1 (default) = fully synchronous,
+                                    # bit-identical to the pre-knob step. k > 1 = each
+                                    # data shard runs k OWNER-LOCAL steps against its
+                                    # own params replica (the shard_map schedule's
+                                    # owner-local gather/scatter machinery, so zero
+                                    # update bytes cross the model axis AND zero bytes
+                                    # cross the data axis inside the window), then ONE
+                                    # delta-merge collective reconciles the data axis:
+                                    # merged = start + psum(local − start, data)/nd —
+                                    # the reference's Hogwild-across-partitions
+                                    # network-thrift discipline (PAPER.md §0, CIKM'16)
+                                    # in its deterministic periodic-averaging form.
+                                    # Per-shard negative lattices are DISJOINT, so a
+                                    # merged run is deterministic per (seed, mesh, k).
+                                    # shard_map lowering only (the owner-local window
+                                    # doesn't exist under GSPMD — refused at
+                                    # construction); must divide steps_per_dispatch so
+                                    # every dispatch boundary is a merge boundary
+                                    # (snapshot/rollback/preemption-save never see an
+                                    # unmerged shard). Priced: tools/collectives.py
+                                    # --sync-every; quality-gated: tools/eval_quality
+                                    # --localsgd-ab
 
     # --- negative-sampling table (G7; mllib:81,234-244) ---
     unigram_table_size: int = 100_000_000  # kept for compat; the alias sampler is O(2·vocab)
@@ -1116,6 +1139,46 @@ class Word2VecConfig:
                     "step_lowering='shard_map' is the rows-layout schedule "
                     "(owner-local row scatters); embedding_partition="
                     f"{self.embedding_partition!r} keeps GSPMD")
+        # --- sync_every (local-SGD) selection matrix (docs/sharding.md
+        # §Local-SGD; trainer._build_step keeps the dispatch-side twin —
+        # graftlint R8 refusal parity):
+        #   sync_every>1 × gspmd lowering  → refuse (the owner-local window is
+        #       the shard_map schedule's property; GSPMD has no owner-local
+        #       k-step form — and with it no CBOW either, since CBOW keeps
+        #       GSPMD)
+        #   sync_every>1 × device_pairgen  → refuse (the windowed chunk is the
+        #       host packed-pair feed; the device generator's token blocks
+        #       would need their own window plumbing)
+        #   sync_every ∤ steps_per_dispatch → refuse (the window lives inside
+        #       the dispatch chunk's scan; a merge must land on every dispatch
+        #       boundary so recovery never resurrects an unmerged shard)
+        if self.sync_every <= 0:
+            raise ValueError(
+                f"sync_every must be positive (1 = synchronous) "
+                f"but got {self.sync_every}")
+        if self.sync_every > 1:
+            if self.step_lowering != "shard_map":
+                raise ValueError(
+                    f"sync_every={self.sync_every} (local-SGD) requires "
+                    f"step_lowering='shard_map': the k owner-local steps "
+                    f"reuse the explicit schedule's owner-local gather/"
+                    f"scatter machinery, which has no GSPMD form (and no "
+                    f"CBOW form — CBOW runs under GSPMD); got "
+                    f"step_lowering={self.step_lowering!r}")
+            if self.device_pairgen:
+                raise ValueError(
+                    f"sync_every={self.sync_every} (local-SGD) supports the "
+                    f"host packed-pair feed only; device_pairgen's token-"
+                    f"block chunks have no windowed form")
+            if self.steps_per_dispatch % self.sync_every:
+                raise ValueError(
+                    f"sync_every={self.sync_every} must divide "
+                    f"steps_per_dispatch={self.steps_per_dispatch}: the "
+                    f"local-SGD window lives inside the dispatch chunk's "
+                    f"scan and every chunk ends merged, so the merge cadence "
+                    f"cannot exceed or straddle the chunk (snapshot-ring/"
+                    f"rollback/preemption saves land on merge boundaries "
+                    f"only)")
         # --- device_pairgen selection matrix (graftcheck first-run findings,
         # tools/graftcheck/ — these four refusals lived only in
         # Trainer.__init__, so a config could be constructed/serialized that

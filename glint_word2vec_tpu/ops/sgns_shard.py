@@ -62,6 +62,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from glint_word2vec_tpu.ops.sgns import (
     EmbeddingPair, StepMetrics, Stabilizers, clip_update_rows,
     shared_pool_coeffs, shared_pool_loss_terms, stabilize_rows)
+from glint_word2vec_tpu.parallel.distributed import local_sgd_delta_merge
 from glint_word2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -100,6 +101,7 @@ def make_shard_map_sgns_step(
     stabilizers: Optional[Stabilizers] = None,
     fused: bool = False,
     bf16_chain: bool = False,
+    sync_every: int = 1,
 ) -> Callable[..., Tuple[EmbeddingPair, StepMetrics]]:
     """Build the explicitly-scheduled sharded step. The returned function has
     the trainer's ``inner`` signature — ``(params, batch, negatives, alpha) ->
@@ -126,6 +128,27 @@ def make_shard_map_sgns_step(
     on model shard 0 — accumulating it owner-locally would serialize every
     hot update onto one shard, the exact imbalance the owner-local schedule
     exists to avoid (docs/sharding.md records the refusal contract).
+
+    ``sync_every`` (config.sync_every — local-SGD, docs/sharding.md
+    §Local-SGD): 1 (default) returns the synchronous step above, byte-for-byte
+    the pre-knob program. k > 1 returns a WINDOW function with the same outer
+    signature over k-stacked inputs — ``batch`` leaves ``[k, B]``,
+    ``negatives [k, nd·P]`` (each data shard consumes its own DISJOINT
+    ``[k, P]`` pool slice, so merged runs are deterministic per
+    (seed, mesh, k)), ``alpha [k]`` — that runs k OWNER-LOCAL steps per data
+    shard (forward assembly psum over ``model`` per step as above, but the
+    backward applies ONLY this shard's own payload: zero bytes cross the data
+    axis inside the window) and then reconciles the data axis with ONE
+    delta-merge collective (:func:`..parallel.distributed.local_sgd_delta_merge`:
+    mean of per-shard deltas against the window-start state). Metrics come
+    back as ``[k]`` vectors (per-step, data-psum'd once per window). The
+    window's k-step loop is PYTHON-UNROLLED, not a lax.scan — deliberately:
+    the HLO collective audit (tools/collectives.py) counts ops textually and
+    a scan body would hide k−1 of the per-step assembly psums, making the
+    priced schedule a lie. In-window stabilizer passes run owner-locally on
+    the LOCAL touched mask (no mask all_gather); the merge preserves the
+    clamp invariant (a convex combination of rows each with ‖row‖ ≤ c stays
+    in the ball).
     """
     nd = mesh.shape[DATA_AXIS]
     nm = mesh.shape[MODEL_AXIS]
@@ -281,4 +304,162 @@ def make_shard_map_sgns_step(
         return EmbeddingPair(s0, s1), StepMetrics(
             loss=loss, mean_f_pos=mean_f_pos, pairs=pairs)
 
-    return step
+    if sync_every == 1:
+        return step
+
+    # ---- local-SGD window (sync_every = k > 1): k owner-local steps per
+    # data shard, then ONE delta-merge collective over the data axis ----
+    if sync_every < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    k = int(sync_every)
+
+    def owner_local_step(syn0, syn1, centers, contexts, mask, negatives,
+                         alpha, row_offset):
+        """One step of the in-window schedule on THIS shard's diverged
+        replica: same forward assembly (steps 1–2 of the module schedule, the
+        one model-axis psum included) but the backward applies only the
+        shard's OWN payload — no data-axis all_gather, so the window crosses
+        the data axis zero times until the merge. ``negatives`` is this
+        shard's disjoint [P] pool slice; its d_Z rows therefore accumulate
+        only this shard's partials (exactly what the per-shard oracle
+        replays). Returns the updated blocks + the [3] local stat numerators
+        (summed over `data` once per window, not per step)."""
+        vs = syn0.shape[0]
+        bl = centers.shape[0]
+        pool = negatives.shape[0]
+
+        cat = jnp.concatenate([
+            _owned_rows(syn0, centers, row_offset),
+            _owned_rows(syn1, contexts, row_offset),
+            _owned_rows(syn1, negatives, row_offset),
+        ], axis=0)                                   # [2·Bl + P, D] param dtype
+        if nm > 1:
+            cat = jax.lax.psum(cat, MODEL_AXIS)
+        e_in = cat[:bl].astype(compute_dtype)
+        e_pos = cat[bl:2 * bl].astype(compute_dtype)
+        Z = cat[2 * bl:].astype(compute_dtype)
+
+        f_pos, f_neg, neg_valid, g_pos, g_neg = shared_pool_coeffs(
+            e_in, e_pos, Z, contexts, negatives, mask, alpha,
+            num_negatives, sigmoid_mode, logits_dtype,
+            fused=fused, bf16_chain=bf16_chain)
+        gn = g_neg.astype(compute_dtype)
+        d_in = g_pos[:, None].astype(compute_dtype) * e_pos + gn @ Z
+        d_pos = g_pos[:, None].astype(compute_dtype) * e_in
+        d_Z = gn.T @ e_in
+        if stabilizers is not None and stabilizers.update_clip:
+            d_in = clip_update_rows(d_in, stabilizers.update_clip)
+            d_pos = clip_update_rows(d_pos, stabilizers.update_clip)
+
+        dtype = syn0.dtype
+        idx0 = centers
+        upd0 = d_in.astype(dtype)
+        idx1 = jnp.concatenate([contexts, negatives])
+        upd1 = jnp.concatenate([d_pos, d_Z], axis=0).astype(dtype)
+        new_syn0 = _owner_local_scatter_add(syn0, idx0, upd0, row_offset)
+        new_syn1 = _owner_local_scatter_add(syn1, idx1, upd1, row_offset)
+
+        if stabilizers is not None and stabilizers.post_pass:
+            # owner-local in-window form: the LOCAL touched mask gates the
+            # pass (no data-axis mask all_gather — the window's whole point);
+            # each shard clamps the rows IT touched, and the merge preserves
+            # the clamp ball (convexity — see local_sgd_delta_merge)
+            enable = (mask.sum() > 0).astype(jnp.float32)
+            sent = jnp.int32(vs * nm)
+            stab0 = jnp.where(mask > 0, idx0, sent)
+            m1 = jnp.concatenate([mask, jnp.ones((pool,), jnp.float32)])
+            stab1 = jnp.where(m1 > 0, idx1, sent)
+
+            def loc(i):
+                li = i - row_offset
+                return jnp.where((li >= 0) & (li < vs), li, vs)
+
+            new_syn0 = stabilize_rows(
+                new_syn0, loc(stab0), alpha, stabilizers, enable)
+            new_syn1 = stabilize_rows(
+                new_syn1, loc(stab1), alpha, stabilizers, enable)
+
+        if with_metrics:
+            loss_num, fpos_num = shared_pool_loss_terms(
+                f_pos, f_neg, neg_valid, mask, num_negatives)
+            stats = jnp.stack([loss_num, fpos_num, mask.sum()])
+        else:
+            stats = jnp.stack(
+                [jnp.float32(0.0), jnp.float32(0.0), mask.sum()])
+        return new_syn0, new_syn1, stats
+
+    def local_window(syn0, syn1, centers, contexts, mask, negatives, alphas):
+        # per-device blocks: syn0/syn1 [Vs, D]; centers/contexts/mask
+        # [k, Bl]; negatives [k, P] (this shard's disjoint lattice); alphas
+        # [k] replicated. Same serialization barrier as the k=1 step: every
+        # collective in the window (the per-step assembly psums, the merge
+        # psum, the stats psum) must data-depend on the params carry.
+        centers, contexts, mask, negatives, syn0, syn1 = (
+            jax.lax.optimization_barrier(
+                (centers, contexts, mask, negatives, syn0, syn1)))
+        vs = syn0.shape[0]
+        row_offset = (jax.lax.axis_index(MODEL_AXIS) * vs).astype(jnp.int32)
+        start0, start1 = syn0, syn1
+        stats_steps = []
+        # Python-unrolled on purpose (see make_shard_map_sgns_step docstring):
+        # the HLO bytes audit must see all k assembly psums
+        for i in range(k):
+            syn0, syn1, st = owner_local_step(
+                syn0, syn1, centers[i], contexts[i], mask[i], negatives[i],
+                alphas[i], row_offset)
+            stats_steps.append(st)
+
+        # the ONE data-axis collective of the window
+        merged0, merged1 = local_sgd_delta_merge(
+            (start0, start1), (syn0, syn1), DATA_AXIS, nd)
+
+        stats = jnp.stack(stats_steps)               # [k, 3]
+        if nd > 1:
+            stats = jax.lax.psum(stats, DATA_AXIS)
+        pairs = stats[:, 2]
+        if with_metrics:
+            denom = jnp.maximum(pairs, 1.0)
+            loss, mean_f_pos = stats[:, 0] / denom, stats[:, 1] / denom
+        else:
+            loss = mean_f_pos = jnp.zeros((k,), jnp.float32)
+        return merged0, merged1, loss, mean_f_pos, pairs
+
+    mapped_window = shard_map(
+        local_window, mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None),
+                  P(None, DATA_AXIS), P(None, DATA_AXIS), P(None, DATA_AXIS),
+                  P(None, DATA_AXIS), P()),
+        out_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None), P(), P(), P()),
+        # replication holds BY the merge (bitwise-identical psum result +
+        # replicated start on every data replica), but the tracer cannot
+        # prove it through the scatters — same waiver as the k=1 step
+        check_rep=False)
+
+    def window(params, batch, negatives, alphas):
+        syn0, syn1 = params
+        v, b = syn0.shape[0], batch["centers"].shape[1]
+        if v % nm:
+            raise ValueError(
+                f"shard_map window needs the padded vocab ({v}) divisible "
+                f"by num_model={nm} (pad_vocab_for_sharding guarantees this "
+                "in the trainer)")
+        if b % nd:
+            raise ValueError(
+                f"shard_map window needs the batch ({b}) divisible by "
+                f"num_data={nd}")
+        if batch["centers"].shape[0] != k:
+            raise ValueError(
+                f"sync_every={k} window needs [k, B]-stacked batch leaves, "
+                f"got leading dim {batch['centers'].shape[0]}")
+        if negatives.shape[1] % nd:
+            raise ValueError(
+                f"sync_every={k} window needs the pool axis "
+                f"({negatives.shape[1]}) divisible by num_data={nd} (each "
+                f"data shard consumes a disjoint slice)")
+        s0, s1, loss, mean_f_pos, pairs = mapped_window(
+            syn0, syn1, batch["centers"], batch["contexts"], batch["mask"],
+            negatives, alphas)
+        return EmbeddingPair(s0, s1), StepMetrics(
+            loss=loss, mean_f_pos=mean_f_pos, pairs=pairs)
+
+    return window

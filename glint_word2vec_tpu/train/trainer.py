@@ -103,6 +103,16 @@ class HeartbeatRecord:
     phases: Optional[dict] = None  # per-phase log2 duration histograms over
                                    # this heartbeat window (obs/phases.py)
                                    # when time attribution is armed
+    # --- local-SGD window metadata (config.sync_every, docs/sharding.md
+    # §Local-SGD): which merge cadence this run dispatched under and how many
+    # delta-merge rounds have completed — a consumer replaying telemetry can
+    # tell a merged carry from a mid-window one would-be state (there is
+    # none: dispatch boundaries ARE merge boundaries, which is exactly what
+    # these fields let it verify)
+    sync_every: int = 1            # merge cadence (1 = fully synchronous)
+    merge_round: int = -1          # completed delta-merge rounds at this
+                                   # heartbeat (global_step // sync_every);
+                                   # -1 when sync_every == 1 (no windows)
 
 
 class _threaded_iter:
@@ -897,6 +907,12 @@ class Trainer:
                 "fused_logits/bf16_chain support the SGNS XLA chains only "
                 "(not use_pallas/cbow) — config construction refuses these "
                 "combinations")
+        if cfg.sync_every > 1 and cfg.step_lowering != "shard_map":
+            raise ValueError(
+                "sync_every > 1 (local-SGD) requires the shard_map lowering "
+                "— the owner-local k-step window has no GSPMD form; config "
+                "construction refuses this combination (docs/sharding.md "
+                "§Local-SGD)")
         fused = cfg.fused_logits
         chain = cfg.bf16_chain
         hot_k = self._hot_rows
@@ -992,7 +1008,7 @@ class Trainer:
                 inner = make_shard_map_sgns_step(
                     plan.mesh, cfg.negatives, cfg.sigmoid_mode, compute_dtype,
                     logits_dtype, with_metrics, stabilizers=stab,
-                    fused=fused, bf16_chain=chain)
+                    fused=fused, bf16_chain=chain, sync_every=cfg.sync_every)
             else:
                 def inner(params, batch, negatives, alpha):
                     return sgns_step_shared_core(
@@ -1013,6 +1029,13 @@ class Trainer:
                             bf16_chain=chain, hot_slabs=slabs)
 
             neg_shape = shared_pool_shape
+            if cfg.step_lowering == "shard_map" and cfg.sync_every > 1:
+                # local-SGD window (docs/sharding.md §Local-SGD): `inner`
+                # consumes [k, B]-stacked batches and [k, nd·P] negatives —
+                # each data shard a DISJOINT [k, P] lattice slice, so the
+                # merged run is deterministic per (seed, mesh, k)
+                neg_shape = lambda K, B: (  # noqa: E731
+                    K, plan.num_data * cfg.negative_pool)
         elif cfg.cbow and cfg.negative_pool > 0 and not cfg.duplicate_scaling:
             if not quiet:
                 self._stability_warnings()
@@ -1065,6 +1088,9 @@ class Trainer:
         is_cbow = cfg.cbow
         S = self._feed_segments
         emb_sharding = self._emb_sharding
+        # > 1 only on the shard_map SGNS path (config refuses every other
+        # combination) — the chunk below scans windows instead of steps
+        sync_k = cfg.sync_every
 
         if cfg.device_pairgen:
             from glint_word2vec_tpu.ops.pairgen import device_block_pairs
@@ -1189,6 +1215,41 @@ class Trainer:
                 return new_p, metrics
 
             xs_all = (arrays, alphas, reals, negatives)
+            if sync_k > 1:
+                # local-SGD windowed dispatch (config.sync_every, docs/
+                # sharding.md §Local-SGD): the chunk scans over K/k WINDOWS,
+                # each a single shard_map program running k owner-local steps
+                # per data shard + the one delta-merge collective. Config
+                # guarantees k | steps_per_dispatch, so every dispatch
+                # boundary is a merge boundary: the params carry this scan
+                # hands back is always fully merged — snapshot-ring/rollback
+                # and the preemption save (all of which run between
+                # dispatches) can never resurrect an unmerged shard. Metrics
+                # come back [W, k] and reshape to the [K] layout
+                # _finish_round expects.
+                W = K // sync_k
+
+                def build_window(xs, real):          # real: [k, S]
+                    mask = (pos[None, None, :] < real[:, :, None]).astype(
+                        jnp.float32).reshape(sync_k, -1)
+                    prs = xs["pairs"].astype(jnp.int32)   # [k, 2, B]
+                    return {"centers": prs[:, 0], "contexts": prs[:, 1],
+                            "mask": mask}
+
+                def body_window(p, inp):
+                    xs, alpha, real, negs = inp
+                    new_p, metrics = inner(
+                        p, build_window(xs, real), negs, alpha)
+                    new_p = jax.lax.with_sharding_constraint(
+                        new_p, EmbeddingPair(emb_sharding, emb_sharding))
+                    return new_p, metrics
+
+                xs_win = jax.tree.map(
+                    lambda x: x.reshape((W, sync_k) + x.shape[1:]), xs_all)
+                final_p, m = jax.lax.scan(body_window, params, xs_win)
+                m = jax.tree.map(
+                    lambda x: x.reshape((K,) + x.shape[2:]), m)
+                return final_p, m
             if not hot_k:
                 return jax.lax.scan(body, params, xs_all)
 
@@ -3212,7 +3273,10 @@ class Trainer:
                 norms=channels,
                 recoveries=self.recoveries_performed,
                 lr_scale=lr_scale_at_dispatch,
-                phases=phases_window)
+                phases=phases_window,
+                sync_every=int(cfg.sync_every),
+                merge_round=(self.global_step // cfg.sync_every
+                             if cfg.sync_every > 1 else -1))
             self._last_hb_host_wait = self.host_wait_time
             self._last_hb_dispatch = self.dispatch_time
             self.heartbeats.append(rec)
@@ -3230,6 +3294,11 @@ class Trainer:
                     dispatch_s=round(rec.dispatch_s, 6),
                     recoveries=int(rec.recoveries),
                     lr_scale=round(float(rec.lr_scale), 9),
+                    # local-SGD runs only: the synchronous default keeps the
+                    # pre-knob record shape byte-identical
+                    **({"sync_every": rec.sync_every,
+                        "merge_round": rec.merge_round}
+                       if rec.sync_every > 1 else {}),
                     **({"norms": channels} if channels is not None else {}),
                     **({"phases": phases_window} if phases_window else {}))
             if on_heartbeat is not None:

@@ -4,7 +4,9 @@ check must NOT count as coverage for the {cbow, negative_pool} dispatch
 combo — its condition says nothing about the combination. The max_row_norm
 range check likewise must not cover the {use_pallas, max_row_norm}
 stabilizer-knob dispatch refusal (the ISSUE-7 regression class: a NEW knob
-lands with a dispatch-only refusal)."""
+lands with a dispatch-only refusal), and the sync_every POSITIVITY check
+must not cover the {sync_every, step_lowering} dispatch refusal (the
+ISSUE-17 class: a cadence knob whose window exists for one lowering only)."""
 import dataclasses
 
 
@@ -16,6 +18,8 @@ class Word2VecConfig:
     negative_pool: int = -1
     max_row_norm: float = 0.0
     vector_size: int = 100
+    step_lowering: str = "gspmd"
+    sync_every: int = 1
 
     def __post_init__(self) -> None:
         if self.vector_size <= 0:
@@ -24,3 +28,5 @@ class Word2VecConfig:
             raise ValueError("negative_pool must be >= -1")
         if self.max_row_norm < 0:
             raise ValueError("max_row_norm must be nonnegative")
+        if self.sync_every <= 0:
+            raise ValueError("sync_every must be positive")

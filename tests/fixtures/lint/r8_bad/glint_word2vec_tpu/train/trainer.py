@@ -1,10 +1,12 @@
-"""R8 bad trainer half: four dispatch-only refusals — one with no config
+"""R8 bad trainer half: five dispatch-only refusals — one with no config
 twin at all (cbow x use_pallas), one 'covered' only by a single-knob range
 check (cbow x negative_pool), which is not coverage, one on a NEW
 stabilizer knob (use_pallas x max_row_norm) whose range check in config is
-likewise not combination coverage, and one living in __init__ path
-selection rather than _build_step (the device_pairgen class graftcheck's
-first run caught in the real tree)."""
+likewise not combination coverage, one living in __init__ path selection
+rather than _build_step (the device_pairgen class graftcheck's first run
+caught in the real tree), and one on a step-cadence knob valid for one
+lowering only (sync_every x step_lowering — the ISSUE-17 class) whose
+config-side positivity check is not combination coverage either."""
 
 
 class Trainer:
@@ -24,4 +26,7 @@ class Trainer:
         if cfg.cbow:
             if cfg.negative_pool == 0:
                 raise ValueError("cbow needs the shared pool here")
+        if cfg.sync_every > 1:
+            if cfg.step_lowering != "shard_map":
+                raise ValueError("sync_every needs the shard_map lowering")
         return None

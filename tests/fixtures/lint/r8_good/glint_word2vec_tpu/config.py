@@ -12,6 +12,8 @@ class Word2VecConfig:
     negative_pool: int = -1
     max_row_norm: float = 0.0
     vector_size: int = 100
+    step_lowering: str = "gspmd"
+    sync_every: int = 1
 
     def __post_init__(self) -> None:
         if self.vector_size <= 0:
@@ -20,6 +22,8 @@ class Word2VecConfig:
             raise ValueError("negative_pool must be >= -1")
         if self.max_row_norm < 0:
             raise ValueError("max_row_norm must be nonnegative")
+        if self.sync_every <= 0:
+            raise ValueError("sync_every must be positive")
         if self.use_pallas:
             if self.cbow:
                 raise ValueError("use_pallas is SGNS-only")
@@ -29,3 +33,5 @@ class Word2VecConfig:
             raise ValueError("device feed is skip-gram only")
         if self.cbow and self.negative_pool == 0:
             raise ValueError("cbow needs the shared pool here")
+        if self.sync_every > 1 and self.step_lowering != "shard_map":
+            raise ValueError("sync_every needs the shard_map lowering")

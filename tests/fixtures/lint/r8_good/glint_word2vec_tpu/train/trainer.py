@@ -1,5 +1,5 @@
-"""R8 good trainer half: same dispatch guards (including the __init__ one);
-config carries every twin."""
+"""R8 good trainer half: same dispatch guards (including the __init__ one
+and the sync_every cadence guard); config carries every twin."""
 
 
 class Trainer:
@@ -19,4 +19,7 @@ class Trainer:
         if cfg.cbow:
             if cfg.negative_pool == 0:
                 raise ValueError("cbow needs the shared pool here")
+        if cfg.sync_every > 1:
+            if cfg.step_lowering != "shard_map":
+                raise ValueError("sync_every needs the shard_map lowering")
         return None

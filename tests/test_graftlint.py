@@ -114,6 +114,10 @@ def test_r8_fires_on_bad_pair_and_not_on_good_pair():
     # the device_pairgen class graftcheck's first run caught in the real
     # tree (ISSUE 8): __init__ is now a scanned dispatch surface
     assert any("device_pairgen" in m and "cbow" in m for m in msgs), bad
+    # a step-cadence knob whose window exists for one lowering only
+    # (ISSUE 17): the config-side positivity check on sync_every must not
+    # count as coverage for the {sync_every, step_lowering} dispatch combo
+    assert any("sync_every" in m and "step_lowering" in m for m in msgs), bad
     good = rule.check_repo(os.path.join(FIXTURES, "r8_good"))
     assert not good, good
 

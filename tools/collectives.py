@@ -157,7 +157,8 @@ def parse_collectives(hlo_text: str, num_data: int, num_model: int) -> list:
     return rows
 
 
-def summarize(rows: list, assembly_rows: int = None) -> dict:
+def summarize(rows: list, assembly_rows: int = None,
+              assembly_count: int = 1) -> dict:
     by_axis = {}
     for r in rows:
         by_axis[r["axis"]] = by_axis.get(r["axis"], 0) + r["bytes"]
@@ -168,22 +169,31 @@ def summarize(rows: list, assembly_rows: int = None) -> dict:
         "bytes_by_axis": by_axis,
     }
     if assembly_rows is not None:
-        # shard_map schedule claim: the ONLY model-axis collective is the
-        # forward row-assembly psum -> model-axis UPDATE bytes are zero.
+        # shard_map schedule claim: the ONLY model-axis collectives are the
+        # forward row-assembly psums -> model-axis UPDATE bytes are zero.
         # Computed, not asserted: subtract every model-axis all-reduce whose
         # leading dim is the assembly row count (2·Bl + P; matched on ROWS,
         # not bytes — CPU float normalization can rewrite a bf16 collective
         # to f32, see run()); anything left over is flagged.
+        # ``assembly_count``: how many assembly psums the program legitimately
+        # carries — 1 for the synchronous step, k for a sync_every=k local-SGD
+        # window (its k-step loop is PYTHON-UNROLLED precisely so each
+        # in-window step's psum appears in the HLO text and is counted here;
+        # a lax.scan body would show its collectives once regardless of trip
+        # count and the tabulated bytes would be a lie).
         residual = 0
         matched = 0
+        matched_n = 0
         for r in [r for r in rows if r["axis"] == "model"]:
             dims = re.search(r"\[(\d+)", r["shape"])
-            if (r["op"] == "all-reduce" and not matched and dims
-                    and int(dims.group(1)) == assembly_rows):
-                matched = r["bytes"]
+            if (r["op"] == "all-reduce" and matched_n < assembly_count
+                    and dims and int(dims.group(1)) == assembly_rows):
+                matched += r["bytes"]
+                matched_n += 1
             else:
                 residual += r["bytes"]
         out["forward_assembly_bytes"] = matched
+        out["forward_assembly_count"] = matched_n
         out["model_axis_update_bytes"] = residual
     return out
 
@@ -194,8 +204,11 @@ def build_geometry(args) -> dict:
     return dict(v=1_000_000, d=384, b=65536, pool=512, param_dtype="bfloat16")
 
 
-def audit_mesh(geom: dict, shape: tuple) -> dict:
-    """Compile both lowerings at one mesh shape; return their summaries."""
+def audit_mesh(geom: dict, shape: tuple, sync_every: int = 1) -> dict:
+    """Compile both lowerings at one mesh shape; return their summaries.
+    ``sync_every=k > 1`` additionally compiles the local-SGD WINDOW program
+    (k owner-local steps + one delta-merge — config.sync_every) and prices
+    its per-window data-axis bytes against both k=1 schedules."""
     import jax
     import jax.numpy as jnp
 
@@ -265,6 +278,55 @@ def audit_mesh(geom: dict, shape: tuple) -> dict:
     out["padded_vocab"] = v
     g, s = out["gspmd"]["total_bytes"], out["shard_map"]["total_bytes"]
     out["bytes_ratio_shard_map_over_gspmd"] = (s / g) if g else None
+
+    if sync_every > 1:
+        # --- the local-SGD window (config.sync_every=k): ONE program = k
+        # owner-local steps + the delta-merge. Its whole point is priced per
+        # WINDOW: the window's data-axis bytes replace what a k-step
+        # synchronous schedule pays k times ---
+        k = sync_every
+        ls_inner = make_shard_map_sgns_step(
+            plan.mesh, NEG, "exact", cdt, ldt, with_metrics=False,
+            sync_every=k)
+
+        def localsgd_window(params, batch, negatives, alphas):
+            new_p, m = ls_inner(params, batch, negatives, alphas)
+            return new_p, m.pairs
+
+        win_batch_sds = {
+            name: jax.ShapeDtypeStruct((k, b), dt,
+                                       sharding=plan.batch_stacked)
+            for name, dt in (("centers", jnp.int32), ("contexts", jnp.int32),
+                             ("mask", jnp.float32))}
+        # disjoint per-shard lattices: [k, nd·pool], pool per shard unchanged
+        win_negs_sds = jax.ShapeDtypeStruct(
+            (k, nd * pool), jnp.int32, sharding=plan.batch_stacked)
+        win_alpha_sds = jax.ShapeDtypeStruct(
+            (k,), jnp.float32, sharding=plan.replicated)
+        p_sds = EmbeddingPair(
+            jax.ShapeDtypeStruct((v, d), pdt, sharding=plan.embedding),
+            jax.ShapeDtypeStruct((v, d), pdt, sharding=plan.embedding))
+        compiled = jax.jit(localsgd_window, donate_argnums=(0,)).lower(
+            p_sds, win_batch_sds, win_negs_sds, win_alpha_sds).compile()
+        rows = parse_collectives(compiled.as_text(), nd, nm)
+        ls = summarize(rows, assembly_rows=2 * (b // nd) + pool,
+                       assembly_count=k)
+        ls["sync_every"] = k
+        # per-WINDOW data-axis bytes vs what each k=1 schedule pays over the
+        # same k steps. The acceptance ratio is against the DEFAULT (gspmd)
+        # synchronous schedule — "the k=1 schedule" a data-parallel run pays
+        # today; the shard_map-baseline ratio is reported beside it (that
+        # schedule's per-step payload all_gather is batch-sized, so the dense
+        # [Vs, D] merge amortizes against it more slowly).
+        win_data = ls["bytes_by_axis"].get("data", 0)
+        g_data = out["gspmd"]["bytes_by_axis"].get("data", 0)
+        s_data = out["shard_map"]["bytes_by_axis"].get("data", 0)
+        ls["window_data_bytes"] = win_data
+        ls["window_data_over_gspmd_k1_schedule"] = (
+            win_data / (k * g_data) if g_data else None)
+        ls["window_data_over_shard_map_k1_schedule"] = (
+            win_data / (k * s_data) if s_data else None)
+        out["localsgd"] = ls
     return out
 
 
@@ -274,6 +336,11 @@ def run(argv=None) -> dict:
                     help="tiny geometry (the tier-1 wiring)")
     ap.add_argument("--mesh", default="all",
                     help="'NDxNM' (e.g. 2x4) or 'all' (1x8,2x4,4x2,8x1)")
+    ap.add_argument("--sync-every", type=int, default=8,
+                    help="local-SGD window length k for the 'localsgd' "
+                         "variant (config.sync_every; 0/1 = skip the "
+                         "variant). The window program is audited per "
+                         "WINDOW — k steps + one delta-merge")
     ap.add_argument("--json-out", default="",
                     help="also write the JSON result to this path")
     args = ap.parse_args(argv)
@@ -302,9 +369,9 @@ def run(argv=None) -> dict:
         log(f"compiling both lowerings at mesh {shape[0]}x{shape[1]} "
             f"(V={geom['v']:,}, B={geom['b']}, D={geom['d']}, "
             f"pool={geom['pool']}, {geom['param_dtype']}) ...")
-        res = audit_mesh(geom, shape)
+        res = audit_mesh(geom, shape, sync_every=max(args.sync_every, 1))
         result["meshes"].append(res)
-        for name in ("gspmd", "shard_map", "gspmd_cols"):
+        for name in ("gspmd", "shard_map", "gspmd_cols", "localsgd"):
             if name not in res:
                 continue
             s = res[name]
@@ -324,6 +391,18 @@ def run(argv=None) -> dict:
             f"{res['bytes_ratio_shard_map_over_gspmd']:.3f}"
             if res["bytes_ratio_shard_map_over_gspmd"] is not None else
             "  gspmd emitted no collectives at this mesh")
+        if "localsgd" in res:
+            ls = res["localsgd"]
+            rg = ls["window_data_over_gspmd_k1_schedule"]
+            rs = ls["window_data_over_shard_map_k1_schedule"]
+            log(f"  localsgd (k={ls['sync_every']}) per-WINDOW data bytes "
+                f"{ls['window_data_bytes'] / 1e6:.2f} MB; model-axis UPDATE "
+                f"bytes {ls['model_axis_update_bytes']} (assembly psums "
+                f"matched: {ls['forward_assembly_count']}); window/k-step "
+                f"ratios: vs gspmd k=1 "
+                + (f"{rg:.4f}" if rg is not None else "n/a (no data axis)")
+                + ", vs shard_map k=1 "
+                + (f"{rs:.4f}" if rs is not None else "n/a"))
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(result, f, indent=1)

@@ -574,6 +574,24 @@ def main():
     ap.add_argument("--hot-flush-every", type=int, default=0,
                     help="hot_flush_every for the hot arm (0 = auto: once "
                          "per dispatch chunk)")
+    # --- local-SGD staleness gate (ISSUE 17 / docs/sharding.md §Local-SGD):
+    # sync_every=k trades k× fewer data-axis collective bytes (priced by
+    # tools/collectives.py --sync-every) for k−1 steps of gradient staleness
+    # per shard, so the knob ships default-off behind THIS measured A/B: two
+    # shard_map arms on the identical corpus/seed over a mesh with a real
+    # data axis, sync_every=1 vs sync_every=--sync-every, scored on the same
+    # ladder. Documented tolerance: the local arm fails the gate when its
+    # purity@10 drops more than 0.03 absolute below the synchronous arm ---
+    ap.add_argument("--localsgd-ab", action="store_true",
+                    help="train TWO shard_map arms on the identical "
+                         "corpus/seed — sync_every=1 and "
+                         "sync_every=--sync-every — on a data-parallel mesh "
+                         "and emit one EVAL_RUNS row per arm "
+                         "(localsgd_ab_arm=sync/local) plus a staleness "
+                         "verdict (purity drop > 0.03 absolute fails)")
+    ap.add_argument("--sync-every", type=int, default=8,
+                    help="sync_every for the local arm of --localsgd-ab "
+                         "(must divide steps_per_dispatch=32)")
     ap.add_argument("--stab-ab", action="store_true",
                     help="train TWO arms on the identical corpus/seed — the "
                          "unmitigated baseline (all stabilizers off, "
@@ -584,6 +602,15 @@ def main():
                          "EVAL_RUNS row per arm, so the collapse rung judges "
                          "the clamp/backoff variants on measured purity")
     args = ap.parse_args()
+
+    if args.localsgd_ab and "jax" not in sys.modules:
+        # the A/B needs a data axis; on a host with no accelerator the CPU
+        # backend exposes 1 device, so self-provision the virtual 8-device
+        # mesh BEFORE jax initializes (the flag is a no-op on real TPU runs)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
 
     from glint_word2vec_tpu.data.corpus import TokenFileCorpus
     from glint_word2vec_tpu.models.estimator import Word2Vec
@@ -646,12 +673,14 @@ def main():
         f"encoded_ext_{args.words}_{args.min_count}")
 
     def run_arm(stab: dict, save_arrays: bool, arm: str = "",
-                arm_field: str = "stab_ab_arm"):
+                arm_field: str = "stab_ab_arm", plan=None):
         """Train one configuration and score it; appends the EVAL_RUNS row
         (ground-truth corpora only) carrying the requested stabilizer knobs
         AND the engaged end state, and returns the result dict. ``arm_field``
-        names the A/B-arm key the row carries (stab_ab_arm / hotrow_ab_arm),
-        so every A/B harness funnels through this one trainer."""
+        names the A/B-arm key the row carries (stab_ab_arm / hotrow_ab_arm /
+        localsgd_ab_arm), so every A/B harness funnels through this one
+        trainer. ``plan`` pins the mesh (the local-SGD A/B needs a real data
+        axis; every other caller takes the default)."""
         est = Word2Vec(
             vector_size=args.dim, min_count=args.min_count, window=5,
             negatives=5, negative_pool=args.pool,
@@ -670,7 +699,7 @@ def main():
             NonFiniteParamsError, NormBlowupError)
         t0 = time.perf_counter()
         try:
-            model = est.fit(sents, encode_cache_dir=cache_dir)
+            model = est.fit(sents, plan=plan, encode_cache_dir=cache_dir)
         except (NonFiniteParamsError, NormBlowupError) as e:
             # an unmitigated arm may halt mid-run (that IS the measurement:
             # the boundary); record the divergence as a row instead of
@@ -872,6 +901,53 @@ def main():
             "parity_ok": (delta is not None and delta >= -0.02),
             "parity_rule": "hot purity_at_10 >= classic - 0.02 absolute",
             "arms": [r_classic, r_hot]}))
+        return
+
+    if args.localsgd_ab:
+        # the ISSUE-17 staleness-vs-throughput gate: synchronous shard_map vs
+        # the sync_every=k owner-local window, identical corpus/seed/mesh,
+        # scored on the same ladder. Documented tolerance: local-arm
+        # purity@10 more than 0.03 absolute below the sync arm fails the
+        # gate (the knob then stays at 1 for that geometry).
+        import jax
+
+        from glint_word2vec_tpu.parallel.mesh import make_mesh
+        if args.sync_every <= 1 or 32 % args.sync_every:
+            ap.error("--sync-every must be > 1 and divide "
+                     f"steps_per_dispatch=32 (got {args.sync_every})")
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            ap.error("--localsgd-ab needs >= 2 devices for a data axis "
+                     f"(have {n_dev})")
+        # widest data axis the device count allows, capped at 2 shards of
+        # model parallelism — matches the headline 2x4 pricing geometry on 8
+        # devices while still degrading to 2x1 on a 2-device host
+        plan = make_mesh(max(2, n_dev // 4))
+        log(f"localsgd-ab mesh: {plan.num_data}x{plan.num_model}, "
+            f"sync_every={args.sync_every}")
+        r_sync = run_arm(dict(step_lowering="shard_map", sync_every=1),
+                         save_arrays=False, arm="sync",
+                         arm_field="localsgd_ab_arm", plan=plan)
+        r_local = run_arm(dict(step_lowering="shard_map",
+                               sync_every=args.sync_every),
+                          save_arrays=True, arm="local",
+                          arm_field="localsgd_ab_arm", plan=plan)
+        delta = analogy_delta = None
+        if "purity_at_10" in r_sync and "purity_at_10" in r_local:
+            delta = round(r_local["purity_at_10"] - r_sync["purity_at_10"], 4)
+        if ("analogy_accuracy_at_1" in r_sync
+                and "analogy_accuracy_at_1" in r_local):
+            analogy_delta = round(r_local["analogy_accuracy_at_1"]
+                                  - r_sync["analogy_accuracy_at_1"], 4)
+        print(json.dumps({
+            "metric": "localsgd_ab",
+            "sync_every": args.sync_every,
+            "mesh": [plan.num_data, plan.num_model],
+            "purity_delta": delta,
+            "analogy_delta": analogy_delta,
+            "staleness_ok": (delta is not None and delta >= -0.03),
+            "staleness_rule": "local purity_at_10 >= sync - 0.03 absolute",
+            "arms": [r_sync, r_local]}))
         return
 
     stab = dict(max_row_norm=args.max_row_norm, update_clip=args.update_clip,

@@ -92,6 +92,10 @@ KNOBS = {k.name: k for k in [
     _K("min_alpha_factor", (1e-4, 1.0), dispatch_inert=True),
     _K("decay_interval_words", (1, 10_000), dispatch_inert=True),
     _K("steps_per_dispatch", (1, 16), invalid=0),
+    # local-SGD merge cadence (ISSUE 17): 2 exercises the window dispatch
+    # path (shard_map-only, must divide steps_per_dispatch — both refusal
+    # twins live in config __post_init__ beside the dispatch guards)
+    _K("sync_every", (1, 2), invalid=0),
     _K("heartbeat_every_steps", (2, 100), invalid=0, dispatch_inert=True),
     _K("prefetch_chunks", (0, 8), invalid=-1, dispatch_inert=True),
     _K("profile_dir", ("",), dispatch_inert=True,

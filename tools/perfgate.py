@@ -74,6 +74,29 @@ GATED: Dict[str, float] = {
     "step_fused_pairs_per_sec": 0.12,
     "step_bf16_chain_pairs_per_sec": 0.12,
     "step_hotrow_pairs_per_sec": 0.15,
+    # --- flat per-row scalars (ISSUE 17 satellite): bench.py now emits one
+    # `step_<row>_pairs_per_sec` per step row as a top-level scalar, the
+    # PREFERRED gate names going forward — every step row gets gated by a
+    # stable flat name instead of only the hand-picked subset above.
+    # _load_parsed back-fills them for older rungs from the legacy aliases
+    # (same harness, same number), so history exists from r04 on. Bands
+    # mirror the per-row counterparts; the `step_<row>_step_ms` flats ride
+    # in the bench line for dashboards but are NOT gated here (the gate
+    # rule is higher-is-better) ---
+    "step_f32_p512_pairs_per_sec": 0.12,
+    "step_bf16_p512_pairs_per_sec": 0.12,
+    "step_bf16_p1024_pairs_per_sec": 0.12,
+    "step_bf16_fused_pairs_per_sec": 0.12,
+    "step_bf16_hot_pairs_per_sec": 0.15,
+}
+
+# legacy top-level name -> flat per-row name (back-fill for rungs that
+# predate the flats; the pairs are the SAME measurement, so aliasing is
+# honest). bf16_chain already used the flat-style name, so it needs no alias.
+_FLAT_ALIASES = {
+    "step_f32_pairs_per_sec": "step_f32_p512_pairs_per_sec",
+    "step_fused_pairs_per_sec": "step_bf16_fused_pairs_per_sec",
+    "step_hotrow_pairs_per_sec": "step_bf16_hot_pairs_per_sec",
 }
 
 # the SERVING trajectory's bands (--kind serve, SERVEBENCH_r*.json from
@@ -106,10 +129,24 @@ def log(msg: str) -> None:
 
 def _load_parsed(path: str) -> dict:
     """A bench JSON: either the raw one-line bench.py output (the metric
-    dict itself) or a driver capture wrapping it under 'parsed'."""
+    dict itself) or a driver capture wrapping it under 'parsed'. Back-fills
+    the flat per-row scalars for rungs that predate them (BENCH r04-r06):
+    legacy aliases are the same measurement under an older name, and
+    `step_<row>_step_ms` is the nested trial median — so the aliased flat
+    gates have history instead of silently skipping every old rung. Rows
+    that never had a top-level name (bf16_p512/bf16_p1024) start gating at
+    the first rung that carries the flats, like the ISSUE-14 rows did."""
     with open(path, "r", encoding="utf-8") as f:
         doc = json.load(f)
-    return doc.get("parsed", doc)
+    doc = doc.get("parsed", doc)
+    for old, new in _FLAT_ALIASES.items():
+        if doc.get(new) is None and doc.get(old) is not None:
+            doc[new] = doc[old]
+    trials = doc.get("step_trials_ms") or {}
+    for k, st in trials.items():
+        if isinstance(st, dict) and st.get("ms_median") is not None:
+            doc.setdefault(f"step_{k}_step_ms", st["ms_median"])
+    return doc
 
 
 def load_trajectory(pattern: str) -> List[dict]:

@@ -55,15 +55,17 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_trainer(lowering: str, shape, vocab, args):
+def make_trainer(lowering: str, shape, vocab, args, sync_every: int = 1,
+                 steps_per_dispatch: int = 0):
     from glint_word2vec_tpu.config import Word2VecConfig
     from glint_word2vec_tpu.parallel.mesh import make_mesh
     from glint_word2vec_tpu.train.trainer import Trainer
 
     cfg = Word2VecConfig(
         vector_size=args.d, min_count=1, pairs_per_batch=args.b,
-        negatives=5, negative_pool=args.pool, steps_per_dispatch=args.k,
-        window=5, seed=7, step_lowering=lowering)
+        negatives=5, negative_pool=args.pool,
+        steps_per_dispatch=steps_per_dispatch or args.k,
+        window=5, seed=7, step_lowering=lowering, sync_every=sync_every)
     return Trainer(cfg, vocab, plan=make_mesh(*shape))
 
 
@@ -156,6 +158,98 @@ def ab_one_mesh(shape, vocab, args) -> dict:
     return res
 
 
+def localsgd_ab_one_mesh(shape, vocab, args) -> dict:
+    """sync_every interleaved arm (docs/sharding.md §Local-SGD): same mesh,
+    same packed-pair chunk, shard_map lowering throughout; arms differ ONLY in
+    ``config.sync_every`` ∈ args.sync_set. Every arm runs with
+    steps_per_dispatch = max(sync_set) so chunk geometry (and therefore the
+    feed, the metrics shape, and the per-step normalization) is identical —
+    only the merge cadence moves. Reports per-arm ms/step plus the one-chunk
+    params divergence of each local arm vs the sync_every=1 arm (the staleness
+    column; quality impact is gated by tools/eval_quality.py --localsgd-ab)."""
+    import jax
+    import jax.numpy as jnp
+    from microbench import time_chunked
+
+    from glint_word2vec_tpu.ops.sgns import EmbeddingPair
+
+    ks = sorted(set(args.sync_set))
+    K, B = max(ks), args.b
+    res = {"mesh": list(shape), "steps_per_dispatch": K, "arms": {}}
+    trainers = {k: make_trainer("shard_map", shape, vocab, args,
+                                sync_every=k, steps_per_dispatch=K)
+                for k in ks}
+    t0 = trainers[ks[0]]
+    syn0_h = np.asarray(t0.params.syn0)
+    syn1_h = np.asarray(t0.params.syn1)
+
+    n_sets = 4
+    feeds = []
+    for i in range(n_sets):
+        r = np.random.default_rng(700 + i)
+        feeds.append(jax.device_put(
+            r.integers(0, vocab.size, (K, 2, B)).astype(t0._pair_dtype),
+            t0.plan.pairs_stacked))
+    meta = np.stack([np.full((K,), 0.025, np.float32),
+                     np.full((K,), B, np.float32)])
+
+    # one-chunk divergence of each local arm vs the synchronous arm — the
+    # cheap staleness indicator (at nd=1 this is exactly 0 by construction)
+    outs = {}
+    for k, tr in trainers.items():
+        p = EmbeddingPair(jax.device_put(syn0_h, tr.plan.embedding),
+                          jax.device_put(syn1_h, tr.plan.embedding))
+        new_p, _ = tr._step_fn(p, {"pairs": feeds[0]}, meta, np.int32(1),
+                               tr._table_prob, tr._table_alias)
+        outs[k] = jax.tree.map(np.asarray, new_p)
+
+    times = {k: [] for k in ks}
+    for rep in range(args.repeats):
+        for k in ks:                                # interleaved
+            tr = trainers[k]
+
+            def run_step(p, feed, base, tr=tr):
+                return tr._step_fn(p, {"pairs": feed}, meta, base,
+                                   tr._table_prob, tr._table_alias)
+
+            make_carry = lambda tr=tr: EmbeddingPair(       # noqa: E731
+                jax.device_put(syn0_h, tr.plan.embedding),
+                jax.device_put(syn1_h, tr.plan.embedding))
+            args_for_iter = lambda i: (feeds[i % n_sets],   # noqa: E731
+                                       np.int32(100 + i))
+            fetch = lambda c, out: c.syn0[0, 0].astype(jnp.float32)  # noqa: E731
+            try:
+                spc = time_chunked(run_step, make_carry=make_carry,
+                                   args_for_iter=args_for_iter,
+                                   n_lo=2, n_hi=6, fetch=fetch)
+            except RuntimeError:
+                import time as _time
+                c = make_carry()
+                c, out = run_step(c, *args_for_iter(0))     # warm
+                float(fetch(c, out))
+                t1 = _time.perf_counter()
+                n = 4
+                for i in range(n):
+                    c, out = run_step(c, *args_for_iter(i))
+                float(fetch(c, out))
+                spc = (_time.perf_counter() - t1) / n
+            times[k].append(spc / K * 1e3)
+    base_ms = float(np.median(times[ks[0]]))
+    for k in ks:
+        ms = float(np.median(times[k]))
+        diff = max(
+            float(np.max(np.abs(outs[ks[0]].syn0.astype(np.float64)
+                                - outs[k].syn0.astype(np.float64)))),
+            float(np.max(np.abs(outs[ks[0]].syn1.astype(np.float64)
+                                - outs[k].syn1.astype(np.float64)))))
+        res["arms"][str(k)] = {"sync_every": k, "ms_per_step": ms,
+                               "speedup_vs_sync": base_ms / ms,
+                               "max_abs_diff_vs_sync": diff}
+        log(f"mesh {shape[0]}x{shape[1]} localsgd k={k:<3d} {ms:8.2f} ms/step"
+            f"  (x{base_ms / ms:.2f} vs sync)  max|dparam vs sync| {diff:.2e}")
+    return res
+
+
 def run(argv=None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
@@ -166,16 +260,24 @@ def run(argv=None) -> dict:
     ap.add_argument("--pool", type=int, default=512)
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--sync-set", type=str, default="1,4,16",
+                    help="comma list of sync_every arms for the local-SGD A/B")
     args = ap.parse_args(argv)
     if args.smoke:
         args.b, args.v, args.d, args.pool = 1024, 8192, 64, 128
         args.k, args.repeats = 2, 1
+        args.sync_set = "1,2"
+    args.sync_set = [int(s) for s in args.sync_set.split(",") if s.strip()]
 
     import jax
     if len(jax.devices()) < 8:
         raise SystemExit(
             f"need 8 devices (have {len(jax.devices())}); run as a script so "
             "the CPU mesh self-provisions")
+    if (os.cpu_count() or 1) < len(jax.devices()):
+        log(f"WARNING: host has {os.cpu_count()} cores for a "
+            f"{len(jax.devices())}-device virtual mesh — device steps are "
+            "contended; treat ms/step as relative, not absolute")
     log(f"device: {jax.devices()[0]}  B={args.b} V={args.v} D={args.d} "
         f"pool={args.pool} K={args.k} repeats={args.repeats}")
 
@@ -190,6 +292,13 @@ def run(argv=None) -> dict:
         "backend": jax.devices()[0].platform,
         "meshes": [ab_one_mesh(shape, vocab, args) for shape in MESHES],
     }
+    # local-SGD arm: only meshes with >1 data shard carry a real merge (at
+    # nd=1 every sync_every is bit-identical to synchronous); smoke keeps one
+    # mesh so the tier-1 wiring stays cheap
+    ls_meshes = [(2, 4)] if args.smoke else [m for m in MESHES if m[0] > 1]
+    result["localsgd_sync_set"] = args.sync_set
+    result["localsgd_meshes"] = [
+        localsgd_ab_one_mesh(shape, vocab, args) for shape in ls_meshes]
     return result
 
 

@@ -70,7 +70,13 @@ VARIANTS = ("rows_gspmd", "shard_map", "cols", "cbow_banded",
             # bf16 chain twin, which additionally carries the NEW dtype
             # contract — no dense f32 [B, D] intermediate in the lowered
             # bf16 module (dense_f32_bd_free)
-            "rows_gspmd_fused", "rows_gspmd_hot", "rows_gspmd_bf16_chain")
+            "rows_gspmd_fused", "rows_gspmd_hot", "rows_gspmd_bf16_chain",
+            # ISSUE-17 local-SGD: the sync_every=k owner-local window — the
+            # k-step unrolled shard_map body plus the delta-merge psum must
+            # keep donation (window params carry aliased), transfers, dtype,
+            # and one-compile (the window is ONE jitted program, never a
+            # separate merge dispatch)
+            "localsgd")
 # the bf16 twin of the rows step carries the dense-f32 check (contract c)
 BF16_VARIANT = "rows_gspmd_bf16"
 
@@ -119,6 +125,9 @@ def _variant_config_kwargs(variant: str) -> dict:
         return dict(negative_pool=16, param_dtype="bfloat16",
                     compute_dtype="bfloat16", logits_dtype="bfloat16",
                     fused_logits=True, bf16_chain=True)
+    if variant == "localsgd":
+        # sync_every must divide the audit cfg's steps_per_dispatch=2
+        return dict(step_lowering="shard_map", negative_pool=16, sync_every=2)
     if variant == BF16_VARIANT:
         return dict(param_dtype="bfloat16", compute_dtype="bfloat16")
     raise ValueError(f"unknown variant {variant!r}")
@@ -369,6 +378,11 @@ def run(argv=None) -> dict:
     ap.add_argument("--smoke", action="store_true",
                     help="tiny geometry (the tier-1 wiring)")
     ap.add_argument("--mesh", default="2x4", help="'NDxNM', e.g. 2x4")
+    ap.add_argument("--only", default="",
+                    help="comma-separated variant subset (e.g. 'localsgd'); "
+                         "skips the recover-rebuild audit — the full run "
+                         "(and the STEPAUDIT.json baseline) covers all "
+                         "variants")
     ap.add_argument("--json-out", default="",
                     help="also write the JSON result to this path")
     args = ap.parse_args(argv)
@@ -383,14 +397,23 @@ def run(argv=None) -> dict:
             "--xla_force_host_platform_device_count")
 
     geom = smoke_geometry() if args.smoke else full_geometry()
-    result = audit(shape, geom)
-    log("stepaudit: auditing the norm_watch='recover' rebuild contract ...")
-    result["recover_rebuild"] = audit_recover_rebuild(geom)
-    rr = result["recover_rebuild"]
-    log(f"  recover_rebuild  recoveries={rr['recoveries']} "
-        f"rebuilt={rr['rebuilt']} total_compiles={rr['total_compiles']} "
-        f"ok={rr['ok']}")
-    result["ok"] = bool(result["ok"] and rr["ok"])
+    only = None
+    if args.only:
+        only = tuple(s.strip() for s in args.only.split(",") if s.strip())
+        known = VARIANTS + (BF16_VARIANT,)
+        bad = [v for v in only if v not in known]
+        if bad:
+            raise SystemExit(f"unknown variant(s) {bad}; known: {known}")
+    result = audit(shape, geom, variants=only)
+    if only is None:
+        log("stepaudit: auditing the norm_watch='recover' rebuild "
+            "contract ...")
+        result["recover_rebuild"] = audit_recover_rebuild(geom)
+        rr = result["recover_rebuild"]
+        log(f"  recover_rebuild  recoveries={rr['recoveries']} "
+            f"rebuilt={rr['rebuilt']} total_compiles={rr['total_compiles']} "
+            f"ok={rr['ok']}")
+        result["ok"] = bool(result["ok"] and rr["ok"])
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(result, f, indent=1)
