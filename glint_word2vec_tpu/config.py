@@ -700,6 +700,33 @@ class Word2VecConfig:
                                     # scanned — the measured recall >= 0.95
                                     # operating point on clustered embedding
                                     # geometry, tools/servebench.py)
+    serve_ann_quant: str = "f32"    # index storage arm (docs/serving.md §6):
+                                    # "f32" one normalized float copy (exact
+                                    # scores), "int8" per-row-scaled int8
+                                    # codes (~4x smaller, bandwidth-bound
+                                    # scan speedup), "pq" product-quantized
+                                    # codes + ADC scan (~16-32x smaller,
+                                    # exact re-rank restores recall)
+    serve_ann_pq_m: int = 0         # PQ subspaces (x256 centroids each).
+                                    # 0 = AUTO ~D/8 (serve/quant.py
+                                    # auto_pq_m; pq arm only)
+    serve_ann_rerank: int = 0       # exact-re-rank shortlist for quantized
+                                    # arms: top-N by quantized score re-
+                                    # scored against lazily fetched float
+                                    # rows. 0 = AUTO (pq: max(100, 40k),
+                                    # int8: max(32, 4k)), -1 = off
+                                    # (forfeits the recall floor)
+    serve_ann_recall_floor: float = -1.0  # measured-recall@10 refusal gate
+                                    # per build: below floor raises
+                                    # RecallFloorError instead of serving a
+                                    # silently degraded index. -1 = AUTO
+                                    # (documented per-arm floors: int8 0.99,
+                                    # pq 0.95, f32 ungated), 0 = disabled
+    serve_ann_max_densify_bytes: int = 8 << 30  # refuse an in-memory index
+                                    # build whose dense normalized [V, D]
+                                    # f32 copy exceeds this many bytes —
+                                    # the error names the shard-native
+                                    # build as the migration. 0 = unlimited
     serve_reload_poll_s: float = 0.5  # hot-reload watcher poll cadence over
                                     # the checkpoint publish signal
                                     # (metadata.json identity; serve/reload.py)
@@ -1377,6 +1404,28 @@ class Word2VecConfig:
             raise ValueError(
                 f"serve_ann_nprobe must be nonnegative (0 = auto) "
                 f"but got {self.serve_ann_nprobe}")
+        if self.serve_ann_quant not in ("f32", "int8", "pq"):
+            raise ValueError(
+                f"serve_ann_quant must be one of 'f32', 'int8', 'pq' "
+                f"but got {self.serve_ann_quant!r}")
+        if self.serve_ann_pq_m < 0:
+            raise ValueError(
+                f"serve_ann_pq_m must be nonnegative (0 = auto ~D/8) "
+                f"but got {self.serve_ann_pq_m}")
+        if self.serve_ann_rerank < -1:
+            raise ValueError(
+                f"serve_ann_rerank must be -1 (off), 0 (auto), or a "
+                f"positive shortlist size but got {self.serve_ann_rerank}")
+        if not (self.serve_ann_recall_floor == -1.0
+                or 0.0 <= self.serve_ann_recall_floor <= 1.0):
+            raise ValueError(
+                f"serve_ann_recall_floor must be -1 (auto per-arm floor) "
+                f"or in [0, 1] (0 = disabled) "
+                f"but got {self.serve_ann_recall_floor}")
+        if self.serve_ann_max_densify_bytes < 0:
+            raise ValueError(
+                f"serve_ann_max_densify_bytes must be nonnegative "
+                f"(0 = unlimited) but got {self.serve_ann_max_densify_bytes}")
         if self.serve_reload_poll_s <= 0:
             raise ValueError(
                 f"serve_reload_poll_s must be positive "

@@ -118,9 +118,14 @@ def serve_prometheus_text(snap: dict) -> str:
         if q in lat:
             gauge("glint_serve_latency_ms", lat[q], f'{{quantile="{q}"}}')
     ann = snap.get("ann") or {}
-    for field in ("recall_at_10", "nprobe", "centroids", "build_seconds"):
+    for field in ("recall_at_10", "nprobe", "centroids", "build_seconds",
+                  "bytes_per_vector"):
         if field in ann:
             gauge(f"glint_serve_ann_{field}", ann[field])
+    # index footprint (ISSUE 18): bytes the live index OWNS — the capacity-
+    # planning gauge for the quantized arms (docs/serving.md §6)
+    if "index_bytes" in ann:
+        gauge("glint_serve_index_bytes", ann["index_bytes"])
     return "\n".join(lines) + "\n"
 
 
@@ -157,6 +162,8 @@ def fleet_prometheus_text(snap: dict) -> str:
     # surfaces: live scrape here, offline recompute in tools/obs_collect.py)
     from glint_word2vec_tpu.obs.slo import slo_gauge_lines
     slo_gauge_lines(gauge, snap.get("slo") or {})
+    fleet_index_bytes = 0
+    fleet_index_replicas = 0
     for name, rep in (snap.get("replicas") or {}).items():
         lab = f'{{replica="{name}"}}'
         gauge("glint_serve_fleet_breaker_state",
@@ -181,9 +188,19 @@ def fleet_prometheus_text(snap: dict) -> str:
                 gauge("glint_serve_latency_ms", slat[q],
                       f'{{replica="{name}",quantile="{q}"}}')
         ann = stats.get("ann") or {}
-        for field in ("recall_at_10", "nprobe", "centroids"):
+        for field in ("recall_at_10", "nprobe", "centroids",
+                      "bytes_per_vector"):
             if field in ann:
                 gauge(f"glint_serve_ann_{field}", ann[field], lab)
+        if "index_bytes" in ann:
+            gauge("glint_serve_index_bytes", ann["index_bytes"], lab)
+            fleet_index_bytes += ann["index_bytes"]
+            fleet_index_replicas += 1
+    # fleet-wide index footprint: the sum over replicas that reported one
+    # (every replica holds its own copy — the number capacity planning
+    # actually pays; docs/serving.md §6)
+    if fleet_index_replicas:
+        gauge("glint_serve_fleet_index_bytes", fleet_index_bytes)
     return "\n".join(lines) + "\n"
 
 

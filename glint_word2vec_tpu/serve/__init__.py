@@ -7,7 +7,12 @@ Four layers, composable alone or through :class:`EmbeddingService`:
   fast refusal as backpressure.
 - :mod:`.ann` — IVF (coarse k-means + inverted lists) approximate top-k
   over the trained matrix, built at load/publish time, ``nprobe``-tunable,
-  with recall@k measured against the exact oracle at build.
+  with recall@k measured against the exact oracle at build — and gated:
+  a quantized build below its recall floor refuses to publish.
+- :mod:`.quant` — the quantized storage arms (int8 scalar, product
+  quantization + ADC + exact re-rank) and the shard-native build that
+  streams a row-shards checkpoint into codes without a dense [V, D]
+  float32 copy (docs/serving.md §6).
 - :mod:`.reload` — the swap-window-safe loader (single owner of the retry
   logic), the lease-counted swappable serving handle, and the
   checkpoint-publish watcher: zero-downtime hot-reload off the trainer's
@@ -22,7 +27,20 @@ Four layers, composable alone or through :class:`EmbeddingService`:
   never below N-1 across a publish).
 """
 
-from glint_word2vec_tpu.serve.ann import IvfIndex, auto_centroids, auto_nprobe, build_ivf
+from glint_word2vec_tpu.serve.ann import (
+    IvfIndex,
+    RecallFloorError,
+    auto_centroids,
+    auto_nprobe,
+    build_ivf,
+)
+from glint_word2vec_tpu.serve.quant import (
+    Int8Storage,
+    PQStorage,
+    ShardRowFetch,
+    auto_pq_m,
+    build_ivf_from_shards,
+)
 from glint_word2vec_tpu.serve.batcher import (
     BatchingScheduler,
     ServerOverloaded,
@@ -46,6 +64,8 @@ from glint_word2vec_tpu.serve.service import EmbeddingService
 
 __all__ = [
     "IvfIndex", "build_ivf", "auto_centroids", "auto_nprobe",
+    "RecallFloorError", "build_ivf_from_shards", "auto_pq_m",
+    "Int8Storage", "PQStorage", "ShardRowFetch",
     "BatchingScheduler", "ServerOverloaded", "ServiceClosed",
     "CheckpointWatcher", "ServingHandle", "load_with_retry",
     "decorrelated_jitter",

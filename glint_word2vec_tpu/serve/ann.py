@@ -13,19 +13,35 @@ compute-vs-recall trade the classic IVF way:
   nearest centroid, stored as one CSR-style inverted-list layout
   (``offsets [C+1]`` + ``rows [V]``);
 - **search**: score the query against the C centroids, visit only the
-  ``nprobe`` nearest cells, and rank the candidate rows exactly — the
-  scanned fraction is ~``nprobe / C`` of the vocabulary instead of 1.0;
+  ``nprobe`` nearest cells, and rank the candidate rows — the scanned
+  fraction is ~``nprobe / C`` of the vocabulary instead of 1.0;
 - **recall is measured, not assumed**: the build samples rows as queries
   and scores the index against the EXACT full-scan oracle on the same
   normalized matrix; ``stats["recall_at_10"]`` travels with the index, so
   a geometry that breaks IVF's clustering assumption (e.g. a post-blowup
   matrix) is visible at publish time — and tools/eval_quality.py records
-  the same number into EVAL_RUNS rows.
+  the same number into EVAL_RUNS rows. Quantized builds are additionally
+  GATED: a build whose measured recall falls below its resolved floor
+  raises :class:`RecallFloorError` instead of publishing a silently
+  degraded index (docs/serving.md §6).
 
-Host-resident by design: the index holds ONE float32 normalized copy of
-the matrix plus O(V) int32 list structure. Search is numpy (BLAS matmuls
-over small candidate sets) — it deliberately does not touch the device, so
-ANN queries never contend with the exact arm's device dispatches or a
+Storage is pluggable (ISSUE 18, ROADMAP 1(c)): the inverted lists live in
+one of three cell-contiguous layouts behind ``quant=``:
+
+- ``"f32"`` — one float32 normalized copy in the packed-cell layout (the
+  original arm; 4·D bytes/row, exact cosine scores);
+- ``"int8"`` — per-row-scaled int8 codes (serve/quant.py), ~D bytes/row:
+  a probed cell is one contiguous int8 block converted in-cache and
+  scanned by a BLAS matvec, so DRAM traffic per candidate drops ~4×
+  (the packed scan is bandwidth-bound — PERF.md §6);
+- ``"pq"`` — product-quantized codes + per-subspace codebooks
+  (Jégou et al., PAMI 2011), ~2·m bytes/row, scanned via per-query ADC
+  lookup tables, with the top ``rerank`` candidates re-ranked against
+  exact float rows fetched lazily from the index's row source.
+
+Host-resident by design: search is numpy (BLAS matmuls over small
+candidate sets) — it deliberately does not touch the device, so ANN
+queries never contend with the exact arm's device dispatches or a
 co-located trainer's collectives. The exact sharded top-k
 (models/word2vec.py) remains the ground-truth oracle.
 """
@@ -35,16 +51,56 @@ from __future__ import annotations
 import logging
 import math
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
 logger = logging.getLogger("glint_word2vec_tpu")
 
 # chunk sizes bounding host scratch: assignment [chunk, C] and the exact-
-# oracle [chunk, V] score blocks stay under ~256 MB each
+# oracle [Q, chunk] score blocks stay under ~256 MB each
 _ASSIGN_BLOCK_BYTES = 256 << 20
 _ORACLE_BLOCK_BYTES = 256 << 20
+
+# documented per-arm recall@10 floors the AUTO (-1) ``recall_floor``
+# resolves to, measured on production-scale clustered embedding geometry
+# (V >= 400k, tools/servebench.py — SERVEBENCH_r03): both quantized arms
+# rely on their exact re-rank stage to hold these (int8's rescaled dots
+# carry ~1e-2 relative error; PQ's ADC ordering scrambles inside dense
+# clusters) — disabling re-rank (rerank=-1) forfeits the floor. f32 is
+# never auto-gated — its recall is governed by the nprobe choice, and
+# gating it would refuse every legitimately small-nprobe deployment.
+# Toy-scale builds (chaos drills, unit tests) pass an explicit floor
+# (0.0 disables) because IVF probe loss at tiny V dominates any
+# quantization effect.
+RECALL_FLOORS: Dict[str, float] = {"f32": 0.0, "int8": 0.99, "pq": 0.95}
+
+QUANT_MODES = ("f32", "int8", "pq")
+
+
+class RecallFloorError(RuntimeError):
+    """A quantized index build measured recall below its resolved floor
+    and refused to publish (docs/serving.md §6). Carries the measured
+    value and the floor so callers (hot-reload, benches) can report both."""
+
+    def __init__(self, quant: str, measured: float, floor: float):
+        self.quant = quant
+        self.measured = measured
+        self.floor = floor
+        super().__init__(
+            f"{quant} index build refused: measured recall@10 "
+            f"{measured:.4f} < floor {floor:.4f} — the matrix geometry "
+            f"does not support this quantization arm at this nprobe; "
+            f"raise nprobe/rerank, use a weaker arm (int8/f32), or pass "
+            f"an explicit recall_floor to override")
+
+
+def resolve_recall_floor(recall_floor: float, quant: str) -> float:
+    """-1 = AUTO (the documented per-arm floor above); >= 0 explicit
+    (0.0 disables the gate)."""
+    if recall_floor is None or recall_floor < 0:
+        return RECALL_FLOORS[quant]
+    return float(recall_floor)
 
 
 def _normalize_rows(m: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -79,27 +135,109 @@ def _topk_desc(scores: np.ndarray, k: int) -> np.ndarray:
     return cand[np.lexsort((cand, -scores[cand]))][:k]
 
 
+def _kmeans_unit(X: np.ndarray, C: int, rng, iters: int) -> np.ndarray:
+    """Seeded Lloyd over unit rows (cosine assignment, re-normalized
+    means, dead-cell repair from random training rows — deterministic:
+    same X + rng state → the same centroids)."""
+    centroids = X[rng.choice(X.shape[0], size=C, replace=False)].copy()
+    for _ in range(max(iters, 1)):
+        assign = _argmax_rows(X, centroids)
+        sums = np.zeros_like(centroids)
+        np.add.at(sums, assign, X)
+        counts = np.bincount(assign, minlength=C)
+        live = counts > 0
+        sums[live] /= counts[live, None]
+        dead = np.flatnonzero(~live)
+        if dead.size:
+            # re-seed empty cells from random training rows so every
+            # cell stays live (classic Lloyd repair, deterministic)
+            sums[dead] = X[rng.choice(X.shape[0], size=dead.size)]
+        centroids, _ = _normalize_rows(sums)
+    return centroids
+
+
+class F32Storage:
+    """The original packed-cell storage: one contiguous float32 normalized
+    copy in inverted-list order. Scores are exact cosines."""
+
+    kind = "f32"
+
+    def __init__(self, packed: np.ndarray):
+        self._packed = packed            # [V, D] unit rows, list order
+
+    @property
+    def nbytes(self) -> int:
+        return int(self._packed.nbytes)
+
+    def scanner(self, q: np.ndarray) -> Callable[[int, int], np.ndarray]:
+        packed = self._packed
+
+        def scan(lo: int, hi: int) -> np.ndarray:
+            # one contiguous matvec per probed cell (packed layout)
+            return packed[lo:hi] @ q
+
+        return scan
+
+    def reconstruct(self, pos) -> np.ndarray:
+        return self._packed[pos]
+
+    def block(self, lo: int, hi: int) -> np.ndarray:
+        """Exact normalized rows [lo:hi) in PACKED order (oracle scans)."""
+        return self._packed[lo:hi]
+
+
+class MatrixRowFetch:
+    """Lazy exact-row source over a borrowed in-memory matrix: rows are
+    normalized per fetch, nothing beyond the caller's own matrix is held.
+    The quantized arms' re-rank/oracle source for in-memory builds — the
+    model already holds its matrix, so borrowing it costs no extra copy
+    (``index_bytes`` counts only what the index OWNS; docs/serving.md §6).
+    """
+
+    kind = "borrowed-matrix"
+
+    def __init__(self, matrix: np.ndarray):
+        self._matrix = matrix
+
+    def __call__(self, ids: np.ndarray) -> np.ndarray:
+        return _normalize_rows(self._matrix[np.asarray(ids)])[0]
+
+
 class IvfIndex:
     """Built inverted-file index; see :func:`build_ivf`.
 
-    Storage is the PACKED layout: the normalized matrix is reordered so each
-    inverted list is one contiguous row block (``_packed[offsets[c]:
-    offsets[c+1]]`` is cell ``c``). Probing a cell is then a sequential
-    matmul over its block — the naive gather of ~nprobe/C·V scattered rows
-    is DRAM-latency-bound and measured 5-10x slower at V ≥ 400k on this
-    host class. ``_ids`` maps packed positions back to original row ids;
-    ``_row_pos`` is the inverse (for :meth:`vector`)."""
+    Storage is the PACKED layout: rows are reordered so each inverted list
+    is one contiguous block (``storage`` rows ``offsets[c]:offsets[c+1]``
+    are cell ``c``). Probing a cell is then a sequential scan over its
+    block — the naive gather of ~nprobe/C·V scattered rows is
+    DRAM-latency-bound and measured 5-10x slower at V ≥ 400k on this host
+    class. ``_ids`` maps packed positions back to original row ids;
+    ``_row_pos`` is the inverse (for :meth:`vector`).
+
+    ``row_fetch`` (optional) is the exact-row source: ``fetch(ids) ->
+    normalized f32 rows``. Quantized arms use it for the PQ re-rank stage,
+    for exact word-query vectors, and as the :meth:`measure_recall`
+    oracle; without one, :meth:`vector` falls back to dequantized codes
+    and ``measure_recall`` is unavailable after build."""
 
     def __init__(self, centroids: np.ndarray, offsets: np.ndarray,
-                 packed: np.ndarray, ids: np.ndarray, row_pos: np.ndarray,
-                 nprobe: int, stats: Dict):
+                 storage, ids: np.ndarray, row_pos: np.ndarray,
+                 nprobe: int, stats: Dict, rerank: int = 0,
+                 row_fetch: Optional[Callable[[np.ndarray], np.ndarray]]
+                 = None):
         self._centroids = centroids      # [C, D] unit rows
         self._offsets = offsets          # [C + 1] int64
-        self._packed = packed            # [V, D] unit rows, list order
+        self._storage = storage          # cell-contiguous code/row store
         self._ids = ids                  # [V] int32: packed pos -> row id
         self._row_pos = row_pos          # [V] int64: row id -> packed pos
         self.nprobe = int(nprobe)
         self.stats = stats
+        self._rerank = int(rerank)       # 0 = no re-rank stage
+        self._row_fetch = row_fetch
+
+    @property
+    def quant(self) -> str:
+        return self._storage.kind
 
     @property
     def num_centroids(self) -> int:
@@ -107,12 +245,45 @@ class IvfIndex:
 
     @property
     def num_rows(self) -> int:
-        return int(self._packed.shape[0])
+        return int(self._ids.shape[0])
+
+    @property
+    def index_bytes(self) -> int:
+        """Bytes the index OWNS: codes/rows + centroids + list structure
+        (+ codebooks/scales). A borrowed re-rank row source is NOT counted
+        — it is the model's own matrix (in-memory builds) or mmap'd
+        checkpoint shards (shard-native builds), alive either way."""
+        return int(self._storage.nbytes + self._centroids.nbytes
+                   + self._offsets.nbytes + self._ids.nbytes
+                   + self._row_pos.nbytes)
 
     def vector(self, row: int) -> np.ndarray:
         """The indexed (unit-normalized) vector of one row — lets word
-        queries reuse the host copy instead of a device gather."""
-        return self._packed[self._row_pos[row]]
+        queries reuse the host copy instead of a device gather. Exact when
+        a row source exists (f32 storage IS one); dequantized otherwise."""
+        if self._storage.kind == "f32":
+            return self._storage.reconstruct(self._row_pos[row])
+        if self._row_fetch is not None:
+            return self._row_fetch(np.asarray([row]))[0]
+        return self._storage.reconstruct(self._row_pos[row])
+
+    def _resolved_rerank(self, k: int) -> int:
+        """The re-rank candidate count for one top-``k`` search: >0
+        explicit, -1 explicitly off, 0 = AUTO — pq widens to max(100,
+        40k) (ADC's fine ordering scrambles inside dense clusters, where
+        top-10 score gaps are smaller than the reconstruction error, so
+        the shortlist must out-span the cluster); int8's rescaled dots
+        are much tighter, max(32, 4k) heals the ordering. f32 never
+        re-ranks (its scores are already exact)."""
+        if self._row_fetch is None or self._rerank < 0:
+            return 0
+        if self._rerank > 0:
+            return self._rerank
+        if self._storage.kind == "pq":
+            return max(100, 40 * k)
+        if self._storage.kind == "int8":
+            return max(32, 4 * k)
+        return 0
 
     def search(self, queries: np.ndarray, k: int,
                nprobe: Optional[int] = None
@@ -121,9 +292,14 @@ class IvfIndex:
 
         Returns ``(scores [Q, k], row_ids [Q, k])``; slots past the
         candidate count (possible only at tiny nprobe on tiny lists) carry
-        ``(-inf, -1)``. ``nprobe`` overrides the index default; clamped to
-        the centroid count (``nprobe >= C`` degrades to an exact scan and
-        is the recall-1.0 reference point)."""
+        ``(-inf, -1)`` — identical fill semantics across all three storage
+        arms. ``nprobe`` overrides the index default; clamped to the
+        centroid count (``nprobe >= C`` degrades to an exact scan and is
+        the recall-1.0 reference point for f32; quantized arms add their
+        code error). f32 scores are exact cosines; int8 scores are
+        rescaled int8 dots (~1e-2 relative error); pq results are ADC-
+        shortlisted then re-ranked against exact rows, so the RETURNED
+        top-k scores are exact cosines again."""
         q, _ = _normalize_rows(np.atleast_2d(np.asarray(queries, np.float32)))
         C = self.num_centroids
         npr = min(int(nprobe) if nprobe else self.nprobe, C)
@@ -133,12 +309,14 @@ class IvfIndex:
         off = self._offsets
         scores = np.full((Q, k), -np.inf, np.float32)
         idx = np.full((Q, k), -1, np.int64)
+        rerank_n = self._resolved_rerank(k)
         for r in range(Q):
             # probe cells best-first, and past the nprobe budget KEEP
             # probing until the candidate pool covers k (a tiny/uneven cell
             # must not starve the result below the requested top-k — the
             # serve-reload chaos phase caught exactly that at toy vocab)
             order = np.argsort(-cscore[r], kind="stable")
+            scan = self._storage.scanner(q[r])
             parts, pos_parts, got = [], [], 0
             for j, c in enumerate(order):
                 if j >= npr and got >= k:
@@ -146,40 +324,92 @@ class IvfIndex:
                 lo, hi = off[c], off[c + 1]
                 if hi == lo:
                     continue
-                # one contiguous matvec per probed cell (packed layout)
-                parts.append(self._packed[lo:hi] @ q[r])
+                parts.append(scan(lo, hi))
                 pos_parts.append(np.arange(lo, hi))
                 got += hi - lo
             if not parts:
                 continue
             s = np.concatenate(parts)
             pos = np.concatenate(pos_parts)
-            top = _topk_desc(s, min(k, s.size))
-            scores[r, :top.size] = s[top]
-            idx[r, :top.size] = self._ids[pos[top]]
+            if rerank_n:
+                # ADC/quantized shortlist -> exact re-rank: fetch the top
+                # rerank_n candidates' float rows lazily and rank those by
+                # true cosine (asymmetric distance discipline, PAMI 2011)
+                short = _topk_desc(s, min(rerank_n, s.size))
+                cand_ids = self._ids[pos[short]]
+                exact = self._row_fetch(cand_ids) @ q[r]
+                top = _topk_desc(exact, min(k, exact.size))
+                scores[r, :top.size] = exact[top]
+                idx[r, :top.size] = cand_ids[top]
+            else:
+                top = _topk_desc(s, min(k, s.size))
+                scores[r, :top.size] = s[top]
+                idx[r, :top.size] = self._ids[pos[top]]
         return scores, idx
+
+    # -- exact oracle ------------------------------------------------------------------
+
+    def _oracle_blocks(self, chunk: int
+                       ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """(exact normalized rows, their row ids) in bounded blocks — the
+        full-scan oracle's source: f32 storage serves its own packed copy;
+        quantized storages stream through the row source."""
+        V = self.num_rows
+        if self._storage.kind == "f32":
+            for lo in range(0, V, chunk):
+                hi = min(lo + chunk, V)
+                yield self._storage.block(lo, hi), self._ids[lo:hi]
+        elif self._row_fetch is not None:
+            for lo in range(0, V, chunk):
+                ids = np.arange(lo, min(lo + chunk, V))
+                yield self._row_fetch(ids), ids
+        else:
+            raise RuntimeError(
+                "exact-oracle recall needs a row source; this quantized "
+                "index was built with keep_rows=False (recall was still "
+                "measured at build — see index.stats)")
+
+    def _query_rows(self, query_rows: np.ndarray) -> np.ndarray:
+        if self._storage.kind == "f32":
+            return self._storage.reconstruct(self._row_pos[query_rows])
+        if self._row_fetch is not None:
+            return self._row_fetch(query_rows)
+        return np.stack([self._storage.reconstruct(self._row_pos[r])
+                         for r in query_rows])
 
     def measure_recall(self, query_rows: np.ndarray, k: int = 10,
                        nprobe: Optional[int] = None) -> float:
         """recall@k of this index vs the EXACT full-scan oracle on the same
         normalized matrix, querying by row id (self excluded on both arms —
-        the serving semantics)."""
-        qpos = self._row_pos[np.asarray(query_rows)]
-        q = self._packed[qpos]
+        the serving semantics). Quantized arms stream the oracle through
+        their row source in bounded blocks, so the measurement never
+        materializes a dense [V, D] copy either."""
+        query_rows = np.asarray(query_rows)
+        q = self._query_rows(query_rows)
         _, ann_i = self.search(q, k + 1, nprobe)
-        V = self.num_rows
-        chunk = max(1, _ORACLE_BLOCK_BYTES // max(V * 4, 1))
+        Q = q.shape[0]
+        kk = k + 1
+        chunk = max(kk, _ORACLE_BLOCK_BYTES // max(Q * 4, 1))
+        best_s = np.full((Q, kk), -np.inf, np.float32)
+        best_i = np.full((Q, kk), -1, np.int64)
+        for rows, ids in self._oracle_blocks(chunk):
+            s = q @ rows.T                                   # [Q, block]
+            cat_s = np.concatenate([best_s, s], axis=1)
+            cat_i = np.concatenate(
+                [best_i, np.broadcast_to(ids, (Q, ids.shape[0]))], axis=1)
+            sel = np.argpartition(cat_s, cat_s.shape[1] - kk,
+                                  axis=1)[:, -kk:]
+            best_s = np.take_along_axis(cat_s, sel, axis=1)
+            best_i = np.take_along_axis(cat_i, sel, axis=1)
         hits, total = 0, 0
-        for lo in range(0, q.shape[0], chunk):
-            block = q[lo:lo + chunk] @ self._packed.T        # [chunk, V]
-            for r in range(block.shape[0]):
-                qi = int(query_rows[lo + r])
-                exact = [int(self._ids[p])
-                         for p in _topk_desc(block[r], k + 1)
-                         if self._ids[p] != qi][:k]
-                ann = [i for i in ann_i[lo + r] if i >= 0 and i != qi][:k]
-                hits += len(set(exact) & set(ann))
-                total += len(exact)
+        for r in range(Q):
+            qi = int(query_rows[r])
+            order = _topk_desc(best_s[r], kk)
+            exact = [int(best_i[r][p]) for p in order
+                     if best_i[r][p] >= 0 and best_i[r][p] != qi][:k]
+            ann = [i for i in ann_i[r] if i >= 0 and i != qi][:k]
+            hits += len(set(exact) & set(ann))
+            total += len(exact)
         return hits / max(total, 1)
 
 
@@ -197,6 +427,21 @@ def auto_nprobe(num_centroids: int) -> int:
     return max(1, -(-num_centroids // 12))
 
 
+def _gate_recall(index: IvfIndex, rng, nonzero: np.ndarray,
+                 recall_queries: int, recall_k: int, floor: float) -> None:
+    """Measure recall vs the exact oracle (EVERY build that can measure
+    does) and refuse a quantized build below its floor."""
+    probes = rng.choice(nonzero, size=min(recall_queries, nonzero.size),
+                        replace=False)
+    key = ("recall_at_10" if recall_k == 10
+           else f"recall_at_{recall_k}")
+    measured = round(index.measure_recall(probes, k=recall_k), 4)
+    index.stats[key] = measured
+    index.stats["recall_queries"] = int(probes.size)
+    if measured < floor:
+        raise RecallFloorError(index.quant, measured, floor)
+
+
 def build_ivf(
     matrix: np.ndarray,
     num_centroids: int = 0,
@@ -207,6 +452,11 @@ def build_ivf(
     recall_queries: int = 256,
     recall_k: int = 10,
     measure_recall: bool = True,
+    quant: str = "f32",
+    pq_m: int = 0,
+    rerank: int = 0,
+    recall_floor: float = -1.0,
+    keep_rows: bool = True,
 ) -> IvfIndex:
     """Build an :class:`IvfIndex` from a [V, D] embedding matrix (pass the
     UNPADDED ``model.syn0``; sharding padding would only add zero rows).
@@ -216,9 +466,22 @@ def build_ivf(
     config knobs carry the same 0-is-AUTO convention). ``measure_recall``
     scores the built index against the exact oracle on ``recall_queries``
     sampled rows; the result rides ``index.stats`` (and, from there,
-    servebench JSON lines and EVAL_RUNS rows)."""
+    servebench JSON lines and EVAL_RUNS rows).
+
+    Quantization (docs/serving.md §6): ``quant`` picks the storage arm
+    (``f32``/``int8``/``pq``); ``pq_m`` is the PQ subspace count (0 = AUTO,
+    serve/quant.py); ``rerank`` the exact-re-rank shortlist (0 = AUTO:
+    max(32, 4k) for pq, off for int8); ``recall_floor`` the refusal gate
+    (-1 = AUTO per-arm documented floor, 0 disables) — a measured-recall
+    build below floor raises :class:`RecallFloorError`. Quantized arms
+    BORROW the input matrix as their lazy exact-row source (re-rank,
+    word-query vectors, oracle); ``keep_rows=False`` drops it after the
+    build-time recall measurement, leaving a codes-only index."""
     t0 = time.perf_counter()
-    normed, norms = _normalize_rows(np.asarray(matrix, np.float32))
+    if quant not in QUANT_MODES:
+        raise ValueError(f"quant must be one of {QUANT_MODES}, got {quant!r}")
+    src = np.asarray(matrix)
+    normed, norms = _normalize_rows(src)
     V = normed.shape[0]
     nonzero = np.flatnonzero(norms > 0)
     C = int(num_centroids) if num_centroids else auto_centroids(V)
@@ -231,53 +494,71 @@ def build_ivf(
         else:
             train = nonzero
         X = normed[train]
-        centroids = X[rng.choice(X.shape[0], size=C, replace=False)].copy()
-        for _ in range(max(kmeans_iters, 1)):
-            assign = _argmax_rows(X, centroids)
-            sums = np.zeros_like(centroids)
-            np.add.at(sums, assign, X)
-            counts = np.bincount(assign, minlength=C)
-            live = counts > 0
-            sums[live] /= counts[live, None]
-            dead = np.flatnonzero(~live)
-            if dead.size:
-                # re-seed empty cells from random training rows so every
-                # cell stays live (classic Lloyd repair, deterministic)
-                sums[dead] = X[rng.choice(X.shape[0], size=dead.size)]
-            centroids, _ = _normalize_rows(sums)
+        centroids = _kmeans_unit(X, C, rng, kmeans_iters)
     else:
         # degenerate all-zero matrix: one empty-ish cell, exact fallback
         centroids = np.zeros((1, normed.shape[1]), np.float32)
         C = 1
+        X = normed[:0]
 
     assign_all = _argmax_rows(normed, centroids)
     counts = np.bincount(assign_all, minlength=C)
     offsets = np.zeros(C + 1, np.int64)
     np.cumsum(counts, out=offsets[1:])
     ids = np.argsort(assign_all, kind="stable").astype(np.int32)
-    packed = np.ascontiguousarray(normed[ids])   # list-contiguous layout
     row_pos = np.empty(V, np.int64)
     row_pos[ids] = np.arange(V)
 
+    row_fetch = None
+    if quant == "f32":
+        storage = F32Storage(
+            np.ascontiguousarray(normed[ids]))   # list-contiguous layout
+    else:
+        from glint_word2vec_tpu.serve.quant import make_quant_storage
+        storage = make_quant_storage(
+            quant, train_rows=X, seed=seed, pq_m=pq_m,
+            encode_blocks=((normed[ids[lo:lo + 262144]],
+                            np.arange(lo, min(lo + 262144, V)))
+                           for lo in range(0, V, 262144)),
+            num_rows=V, dim=normed.shape[1])
+        row_fetch = MatrixRowFetch(src)
+
     npr = int(nprobe) if nprobe else auto_nprobe(C)
+    floor = resolve_recall_floor(recall_floor, quant)
     stats: Dict = {
+        "quant": quant,
         "centroids": C,
         "nprobe": min(npr, C),
         "rows": V,
         "mean_list_len": round(float(counts.mean()), 2) if C else 0.0,
         "max_list_len": int(counts.max()) if C else 0,
+        "recall_floor": floor,
     }
-    index = IvfIndex(centroids, offsets, packed, ids, row_pos,
-                     min(npr, C), stats)
+    index = IvfIndex(centroids, offsets, storage, ids, row_pos,
+                     min(npr, C), stats, rerank=rerank, row_fetch=row_fetch)
+    _finish_stats(index, t0)
     if measure_recall and nonzero.size > recall_k:
-        probes = rng.choice(nonzero,
-                            size=min(recall_queries, nonzero.size),
-                            replace=False)
-        stats["recall_at_10" if recall_k == 10 else f"recall_at_{recall_k}"] \
-            = round(index.measure_recall(probes, k=recall_k), 4)
-        stats["recall_queries"] = int(probes.size)
+        _gate_recall(index, rng, nonzero, recall_queries, recall_k, floor)
     stats["build_seconds"] = round(time.perf_counter() - t0, 3)
-    logger.info("IVF index built: V=%d C=%d nprobe=%d recall@%d=%s in %.2fs",
-                V, C, stats["nprobe"], recall_k,
-                stats.get(f"recall_at_{recall_k}"), stats["build_seconds"])
+    if not keep_rows and quant != "f32":
+        index._row_fetch = None
+    logger.info("IVF index built: V=%d C=%d nprobe=%d quant=%s recall@%d=%s "
+                "bytes/vec=%s in %.2fs",
+                V, C, stats["nprobe"], quant, recall_k,
+                stats.get(f"recall_at_{recall_k}"),
+                stats["bytes_per_vector"], stats["build_seconds"])
     return index
+
+
+def _finish_stats(index: IvfIndex, t0: float) -> None:
+    """Footprint observability (ISSUE 18 satellite): every build reports
+    what it OWNS — statusd renders these as ``glint_serve_index_bytes`` /
+    ``glint_serve_ann_bytes_per_vector``."""
+    stats = index.stats
+    stats["index_bytes"] = index.index_bytes
+    stats["bytes_per_vector"] = (
+        round(index.index_bytes / max(index.num_rows, 1), 2))
+    if index._storage.kind == "pq":
+        stats["pq_m"] = index._storage.m
+    if index._storage.kind in ("pq", "int8"):
+        stats["rerank"] = index._resolved_rerank(10)

@@ -68,6 +68,12 @@ class EmbeddingService:
         nprobe: Optional[int] = None,
         ann_centroids: Optional[int] = None,
         ann_seed: int = 0,
+        ann_quant: Optional[str] = None,
+        ann_pq_m: Optional[int] = None,
+        ann_rerank: Optional[int] = None,
+        ann_recall_floor: Optional[float] = None,
+        ann_max_densify_bytes: Optional[int] = None,
+        ann_from_shards: bool = False,
         max_batch: Optional[int] = None,
         max_delay_ms: Optional[float] = None,
         queue_depth: Optional[int] = None,
@@ -83,6 +89,27 @@ class EmbeddingService:
         """``straggle_every``/``straggle_ms``: fault injection passed through
         to the batcher (its docstring has the contract) — the fleet hedge
         A/B's deterministic tail-latency straggler. Off by default.
+
+        ``ann_quant``/``ann_pq_m``/``ann_rerank``/``ann_recall_floor``:
+        the quantized-index family (docs/serving.md §6) — which storage
+        arm the build uses (``f32``/``int8``/``pq``), the PQ subspace
+        count, the exact-re-rank shortlist, and the recall-refusal floor.
+        None defers to the checkpoint's ``serve_ann_*`` knobs (the usual
+        resolution rule); every hot-reload rebuilds at the SAME resolved
+        arm and re-measures recall, and a reload whose rebuild lands
+        below floor is refused by the watcher's catch — the old model
+        keeps serving.
+
+        ``ann_max_densify_bytes``: refuse an in-memory index build whose
+        dense normalized copy would exceed this many bytes (0 =
+        unlimited) — the legacy ``np.asarray(model.syn0)`` path OOMs the
+        host long past the point the shard-native build
+        (``ann_from_shards=True``, serve/quant.py) handles fine.
+
+        ``ann_from_shards``: build the index straight from the
+        checkpoint's row-shards files (never materializing dense [V, D]
+        f32; quantized arms only). Requires ``checkpoint=`` with a
+        row-shards layout.
 
         ``ann_index``: a prebuilt :class:`~.ann.IvfIndex` to serve instead
         of building one at init (``ann=True`` only; ``attach_ann``'s
@@ -100,6 +127,10 @@ class EmbeddingService:
             raise ValueError("pass exactly one of checkpoint= or model=")
         if watch and checkpoint is None:
             raise ValueError("watch=True needs a checkpoint path to poll")
+        if ann_from_shards and checkpoint is None:
+            raise ValueError(
+                "ann_from_shards=True builds from the checkpoint's shard "
+                "files — it needs checkpoint=, not an in-memory model")
         self._checkpoint = checkpoint
         # a checkpoint-loaded model is ours to release on close; an
         # in-memory model= stays the caller's (handle.detach on close)
@@ -129,6 +160,18 @@ class EmbeddingService:
                         else _knob(model, "serve_ann_nprobe", None)) or None
         self._ann_centroids = int(
             _knob(model, "serve_ann_centroids", ann_centroids))
+        # quantized-index knobs (docs/serving.md §6): resolved ONCE here,
+        # then every reload rebuilds at the same arm — a V-grew publish
+        # must not silently change quantization mid-fleet
+        self._ann_quant = str(_knob(model, "serve_ann_quant", ann_quant))
+        self._ann_pq_m = int(_knob(model, "serve_ann_pq_m", ann_pq_m))
+        self._ann_rerank = int(_knob(model, "serve_ann_rerank", ann_rerank))
+        self._ann_recall_floor = float(
+            _knob(model, "serve_ann_recall_floor", ann_recall_floor))
+        self._ann_max_densify = int(
+            _knob(model, "serve_ann_max_densify_bytes",
+                  ann_max_densify_bytes))
+        self._ann_from_shards = bool(ann_from_shards)
         try:
             index = self._build_index(model)
             self._handle = ServingHandle(model, index)
@@ -267,11 +310,44 @@ class EmbeddingService:
             # one-shot: only the INIT model may use it (attach_ann still
             # hard-refuses a row-count mismatch); reloads rebuild fresh
             index, self._prebuilt_index = self._prebuilt_index, None
+        elif self._ann_from_shards:
+            # shard-native build (serve/quant.py): streams the checkpoint's
+            # row-shards straight into quantized codes — never a dense
+            # [V, D] f32 copy, so it is also the V-grew hot-reload path at
+            # host-exceeding vocabularies (same quant arm every rebuild)
+            from glint_word2vec_tpu.serve.quant import build_ivf_from_shards
+            index = build_ivf_from_shards(
+                self._checkpoint,
+                quant=self._ann_quant,
+                num_centroids=self._ann_centroids,
+                nprobe=self._nprobe or 0,
+                seed=self._ann_seed,
+                pq_m=self._ann_pq_m,
+                rerank=self._ann_rerank,
+                recall_floor=self._ann_recall_floor)
         else:
+            # legacy in-memory path: densifies model.syn0 into one f32
+            # normalized copy first — guard BEFORE the allocation (today's
+            # alternative is the host OOMing mid-build)
+            would_be = int(model.num_words) * int(model.vector_size) * 4
+            if 0 < self._ann_max_densify < would_be:
+                raise RuntimeError(
+                    f"refusing in-memory ANN build: densifying the "
+                    f"[{model.num_words}, {model.vector_size}] matrix "
+                    f"needs {would_be} bytes of host RAM > "
+                    f"serve_ann_max_densify_bytes={self._ann_max_densify}"
+                    f" — migrate to the shard-native build "
+                    f"(ann_from_shards=True / serve.quant."
+                    f"build_ivf_from_shards, docs/serving.md §6) or "
+                    f"raise the knob explicitly")
             index = build_ivf(np.asarray(model.syn0),
                               num_centroids=self._ann_centroids,
                               nprobe=self._nprobe or 0,
-                              seed=self._ann_seed)
+                              seed=self._ann_seed,
+                              quant=self._ann_quant,
+                              pq_m=self._ann_pq_m,
+                              rerank=self._ann_rerank,
+                              recall_floor=self._ann_recall_floor)
         model.attach_ann(index)
         return index
 

@@ -491,6 +491,7 @@ class ShardedMatrixReader:
 
     def __init__(self, dirpath: str):
         self.dirpath = dirpath
+        self._mmap_cache: Optional[List[tuple]] = None
         self._spans: List[tuple] = []
         for fname in sorted(os.listdir(dirpath)):
             if not fname.startswith("rows-"):
@@ -535,6 +536,26 @@ class ShardedMatrixReader:
 
     def read_all(self, workers: int = 1) -> np.ndarray:
         return self.read(0, self.rows, workers=workers)
+
+    def gather(self, ids: np.ndarray) -> np.ndarray:
+        """Scattered rows by id, in ``ids`` order, gathered through
+        cached per-shard mmap handles — ``read()`` reopens every shard
+        file per call, which is fine for block streaming but dominates
+        when the serving tier's re-rank stage fetches a few hundred
+        scattered rows per query (serve/quant.py). Only the requested
+        rows' pages are touched."""
+        ids = np.asarray(ids)
+        if self._mmap_cache is None:
+            self._mmap_cache = [
+                (s, e, self._undo_void(np.load(
+                    os.path.join(self.dirpath, fname), mmap_mode="r")))
+                for s, e, fname in self._spans]
+        out = np.empty((ids.size, self.cols), dtype=self.dtype)
+        for s, e, m in self._mmap_cache:
+            mask = (ids >= s) & (ids < e)
+            if mask.any():
+                out[mask] = m[ids[mask] - s]
+        return out
 
 
 @_traced("checkpoint_load_plan")

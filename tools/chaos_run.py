@@ -513,6 +513,47 @@ def phase_serve_reload(workdir: str, n_sentences: int) -> str:
         res = service.synonyms("brandnew0", 3)
         if not res or not all(np.isfinite(s) for _, s in res):
             return f"new-vocab word query failed after the V-grew reload: {res}"
+
+        # QUANTIZED V-grew epilogue (ISSUE 18): a second service pinned to
+        # the int8 arm rides the same checkpoint; another vocabulary
+        # extension must hot-reload it at the SAME quant mode with recall
+        # re-measured at the new V (floor 0: toy-vocab probe loss is about
+        # the scale, not the quantizer — docs/serving.md §6), and the
+        # brand-new word must serve through the quantized index
+        qsvc = EmbeddingService(
+            checkpoint=ck, ann=True, watch=True, reload_poll_s=0.02,
+            max_batch=16, max_delay_ms=1.0,
+            ann_quant="int8", ann_recall_floor=0.0)
+        try:
+            before = qsvc.info()["ann"]
+            if before.get("quant") != "int8":
+                return f"quantized service built arm {before.get('quant')!r}"
+            rep2 = extend_checkpoint(ck, {"brandnew2": 30}, min_count=1)
+            deadline = time.monotonic() + 30
+            while (qsvc.info()["num_words"] != rep2["new_vocab_size"]
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            qinfo = qsvc.info()
+            if qinfo["num_words"] != rep2["new_vocab_size"]:
+                return (f"quantized service never reloaded the V-grew "
+                        f"publish (serving {qinfo['num_words']} words, "
+                        f"want {rep2['new_vocab_size']})")
+            after = qinfo["ann"]
+            if after.get("quant") != "int8":
+                return (f"V-grew reload changed the quant arm: "
+                        f"{before.get('quant')!r} -> {after.get('quant')!r}")
+            if after.get("rows") != rep2["new_vocab_size"]:
+                return (f"quantized index not rebuilt at the new V "
+                        f"(index rows {after.get('rows')})")
+            if not isinstance(after.get("recall_at_10"), float):
+                return ("quantized V-grew rebuild did not re-measure "
+                        f"recall: {after.get('recall_at_10')!r}")
+            qres = qsvc.synonyms("brandnew2", 3)
+            if not qres or not all(np.isfinite(s) for _, s in qres):
+                return (f"new-vocab word query failed through the "
+                        f"quantized index: {qres}")
+        finally:
+            qsvc.close()
     finally:
         service.close()
     return ""

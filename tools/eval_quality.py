@@ -345,7 +345,25 @@ def evaluate(words, emb: np.ndarray, index=None) -> dict:
             "ann_centroids": ivf.stats["centroids"],
             "ann_nprobe": ivf.stats["nprobe"],
             "ann_build_s": round(time.perf_counter() - t_ann, 2),
+            "ann_index_bytes": ivf.stats.get("index_bytes"),
         }
+        # quantized-arm recall channels (ISSUE 18): the same oracle
+        # discipline for the int8/PQ builds a deployment would actually
+        # serve — a geometry that quantizes badly (e.g. heavy-tailed rows
+        # blowing the per-row int8 scale) shows up here before a
+        # RecallFloorError does at publish. Floors off: this is the
+        # MEASUREMENT channel; refusal is the serving tier's job.
+        for quant in ("int8", "pq"):
+            try:
+                qix = build_ivf(emb, seed=0, recall_queries=256,
+                                recall_k=10, quant=quant, recall_floor=0.0)
+                ann_channels[f"ann_{quant}_recall_at_10"] = (
+                    qix.stats.get("recall_at_10"))
+                ann_channels[f"ann_{quant}_index_bytes"] = (
+                    qix.stats.get("index_bytes"))
+            except Exception as e:  # noqa: BLE001 — additive channel
+                log(f"ann {quant} channel skipped: "
+                    f"{type(e).__name__}: {e}")
     except Exception as e:  # noqa: BLE001 — index health is additive
         log(f"ann recall channel skipped: {type(e).__name__}: {e}")
     out = {

@@ -157,6 +157,16 @@ KNOBS = {k.name: k for k in [
        dispatch_inert=True),
     _K("serve_ann_nprobe", (0, 1, 64), invalid=-1, auto=0,
        dispatch_inert=True),
+    _K("serve_ann_quant", ("f32", "int8", "pq"), invalid="int4",
+       dispatch_inert=True),
+    _K("serve_ann_pq_m", (0, 8, 16), invalid=-1, auto=0,
+       dispatch_inert=True),
+    _K("serve_ann_rerank", (-1, 0, 64), invalid=-2, auto=0,
+       dispatch_inert=True),
+    _K("serve_ann_recall_floor", (-1.0, 0.0, 0.95), invalid=1.5, auto=-1.0,
+       dispatch_inert=True),
+    _K("serve_ann_max_densify_bytes", (0, 8 << 30), invalid=-1,
+       dispatch_inert=True),
     _K("serve_reload_poll_s", (0.05, 0.5), invalid=0.0, dispatch_inert=True),
     # --- serving-fleet knobs (serve/fleet.py, docs/serving.md §5): read
     # only by the fleet router process (FleetRouter / tools/fleet_run.py),

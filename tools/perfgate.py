@@ -120,6 +120,23 @@ SERVE_GATED: Dict[str, float] = {
     # ratio, higher is better; < 1 would mean hedging HURT) ---
     "fleet3_ann_qps": 0.35,
     "fleet_hedge_p99_cut": 0.35,
+    # --- quantized arms (ISSUE 18, servebench arm 5; gated only once a
+    # rung carries them — r01/r02 predate quantization). qps bands mirror
+    # the f32 ANN arm's scheduling noise; recall is deterministic per
+    # (matrix, seed, arm) so the bands stay tight — a drop means the
+    # quantizer or its auto rules changed, not weather; bytes_cut (f32
+    # bytes over quant bytes, higher is better) is a pure layout property,
+    # tightest of all ---
+    "int8_qps": 0.30,
+    "pq_qps": 0.35,
+    "int8_recall_at_10": 0.03,
+    "pq_recall_at_10": 0.05,
+    "int8_bytes_cut": 0.05,
+    "pq_bytes_cut": 0.05,
+    # the acceptance ratio: int8 closed-loop qps over the f32 ANN arm's
+    # (both arms measured in the same process minutes apart, so the band
+    # can be tighter than either qps alone)
+    "int8_qps_ratio": 0.25,
 }
 
 
@@ -256,21 +273,35 @@ def _run(args) -> tuple:
         s = gate(seeded, rungs, bands)
         fired_on = sorted(k for k, m in s["metrics"].items()
                           if not m["ok"])
+        # the recall gates specifically must prove they fire (ISSUE 18):
+        # a seeded RECALL regression is the silent-degradation failure
+        # mode the quantized arms exist to refuse, so whenever the rungs
+        # carry a recall metric, the seeded line must trip at least one
+        recall_carried = sorted(
+            k for k in bands if "recall" in k
+            and any(r["parsed"].get(k) is not None for r in rungs))
+        recall_fired = sorted(set(fired_on)
+                              & set(recall_carried))
+        recall_ok = not recall_carried or bool(recall_fired)
         result = {
             # the gate is proven iff the real current line is inside band
-            # AND the seeded regression trips it
-            "ok": bool(g["ok"] and not s["ok"]),
+            # AND the seeded regression trips it (including its recall
+            # gates, when the trajectory carries any)
+            "ok": bool(g["ok"] and not s["ok"] and recall_ok),
             "mode": "smoke",
             "kind": args.kind,
             "genuine": {"rung": rungs[-1]["path"], "ok": g["ok"],
                         "metrics": g["metrics"]},
             "seeded": {"factor": args.seed_factor, "ok": s["ok"],
-                       "fired_on": fired_on},
+                       "fired_on": fired_on,
+                       "recall_fired": recall_fired},
             "rungs": g["rungs"],
         }
         log(f"perfgate --smoke: genuine {rungs[-1]['path']} "
             f"{'PASS' if g['ok'] else 'FAIL'}; seeded x{args.seed_factor} "
-            f"{'fired on ' + ','.join(fired_on) if fired_on else 'DID NOT FIRE'}")
+            f"{'fired on ' + ','.join(fired_on) if fired_on else 'DID NOT FIRE'}"
+            + (f"; recall gates fired: {','.join(recall_fired) or 'NONE'}"
+               if recall_carried else ""))
         return result, 0 if result["ok"] else 1
 
     if not args.bench:

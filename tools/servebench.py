@@ -22,6 +22,14 @@ Arms:
    arrival times, refusals (ServerOverloaded, the 429 analog) and p99 are
    counted per target; ``offered_qps_sustained`` is the highest target
    with < 1% refusals.
+5. **quantized arms** (ISSUE 18) — the int8 and PQ index builds through
+   the same closed loop, with footprint columns: ``*_index_bytes``,
+   ``*_bytes_cut`` (f32-index bytes over quant bytes — higher is better,
+   so perfgate can band it), ``int8_qps_ratio`` vs the f32 ANN arm, and
+   each arm's own oracle-measured recall@10. ``--shard-native`` adds a
+   smoke build straight from a row-shards checkpoint
+   (serve/quant.build_ivf_from_shards) with a code-parity check against
+   the in-memory build.
 
 Latency vs throughput reporting: closed-loop percentiles at saturation are
 a QUEUEING artifact (Little's law: N clients / capacity), so the headline
@@ -266,7 +274,12 @@ def fleet_tier(args) -> Dict:
                 m.stop()
 
     out: Dict = {"fleet_vocab": v, "fleet_replicas": n_rep,
-                 "fleet_recall_at_10": index.stats.get("recall_at_10")}
+                 "fleet_recall_at_10": index.stats.get("recall_at_10"),
+                 # in-process replicas SHARE one read-only index; a real
+                 # deployment pays one copy per replica host — both numbers
+                 # derive from this (statusd's fleet scrape sums what each
+                 # replica actually reports)
+                 "fleet_index_bytes": index.stats.get("index_bytes")}
     half_targets: Dict = {}
     for ann in (False, True):
         arm = "ann" if ann else "exact"
@@ -346,6 +359,12 @@ def main() -> int:
                          "REPLICA 0 (one degraded node) stalls "
                          "--straggle-ms (serve/batcher.py)")
     ap.add_argument("--straggle-ms", type=float, default=60.0)
+    ap.add_argument("--shard-native", action="store_true",
+                    help="add the shard-native build leg: save the bench "
+                         "matrix as a row-shards checkpoint, build the "
+                         "int8 index via build_ivf_from_shards (bounded "
+                         "blocks, no dense [V,D] f32), and parity-check "
+                         "its codes against the in-memory build")
     ap.add_argument("--smoke", action="store_true",
                     help="small + fast (CI): proves the harness, not the host")
     args = ap.parse_args()
@@ -425,6 +444,94 @@ def main() -> int:
             sustained = max(sustained, row["achieved_qps"])
     svc.close()
 
+    # -- arm 5: quantized indexes (ISSUE 18) --------------------------------
+    # same closed loop over the int8 and PQ arms; recall floors stay AUTO
+    # (the documented per-arm gates — a full-bench refusal here IS the
+    # signal) except under --smoke, where toy-scale probe loss would fire
+    # the floor about the host, not the code
+    from glint_word2vec_tpu.serve import build_ivf
+    matrix = np.asarray(model.syn0)
+    quant_floor = 0.0 if args.smoke else -1.0
+    quant_fields: Dict = {}
+    f32_bytes = ann_stats.get("index_bytes") or 1
+    for quant in ("int8", "pq"):
+        qix = build_ivf(matrix, nprobe=args.nprobe or 0, seed=args.seed,
+                        quant=quant, recall_floor=quant_floor)
+        qstats = dict(qix.stats)
+        qsvc = EmbeddingService(model=model, ann=True, ann_index=qix,
+                                nprobe=args.nprobe or None)
+        qsvc.synonyms(qwords[0], args.num)  # warm
+        qcl = closed_loop(qsvc, qwords, args.num, args.clients,
+                          args.duration)
+        qsvc.close()
+        quant_fields.update({
+            f"{quant}_qps": qcl["qps"],
+            f"{quant}_closed_p50_ms": qcl["p50_ms"],
+            f"{quant}_closed_p99_ms": qcl["p99_ms"],
+            f"{quant}_recall_at_10": qstats.get("recall_at_10"),
+            f"{quant}_index_bytes": qstats["index_bytes"],
+            f"{quant}_bytes_per_vector": qstats["bytes_per_vector"],
+            f"{quant}_bytes_ratio": round(
+                qstats["index_bytes"] / f32_bytes, 4),
+            # the gateable direction: f32 bytes over quant bytes
+            f"{quant}_bytes_cut": round(
+                f32_bytes / max(qstats["index_bytes"], 1), 2),
+            f"{quant}_qps_ratio": round(
+                qcl["qps"] / max(ann_cl["qps"], 1e-9), 3),
+            f"{quant}_build_s": qstats["build_seconds"],
+        })
+        if quant == "pq":
+            quant_fields["pq_m"] = qstats.get("pq_m")
+            quant_fields["pq_rerank"] = qstats.get("rerank")
+        log(f"{quant} batched: {qcl['qps']} qps ("
+            f"{quant_fields[f'{quant}_qps_ratio']}x f32-ann), recall@10 "
+            f"{qstats.get('recall_at_10')}, "
+            f"{qstats['bytes_per_vector']} B/vec "
+            f"({quant_fields[f'{quant}_bytes_ratio']}x f32 bytes)")
+
+    # -- shard-native build leg (--shard-native) ----------------------------
+    if args.shard_native:
+        import shutil
+        import tempfile
+
+        import jax.numpy as jnp
+
+        from glint_word2vec_tpu.config import Word2VecConfig
+        from glint_word2vec_tpu.serve import build_ivf_from_shards
+        from glint_word2vec_tpu.train.checkpoint import save_model_sharded
+        tmp = tempfile.mkdtemp(prefix="servebench-shards-")
+        try:
+            ck = os.path.join(tmp, "ck")
+            cfg = Word2VecConfig(vector_size=model.vector_size, min_count=1)
+            save_model_sharded(ck, model.vocab.words,
+                               np.asarray(model.vocab.counts),
+                               jnp.asarray(matrix), None, cfg,
+                               vocab_size=model.num_words,
+                               vector_size=model.vector_size)
+            six = build_ivf_from_shards(
+                ck, quant="int8", nprobe=args.nprobe or 0, seed=args.seed,
+                recall_floor=quant_floor)
+            # proof the stream is the same index: the in-memory int8 build
+            # at the same seed produced bit-identical codes
+            mem = build_ivf(matrix, nprobe=args.nprobe or 0,
+                            seed=args.seed, quant="int8",
+                            recall_floor=quant_floor)
+            parity = bool(
+                np.array_equal(mem._ids, six._ids)
+                and np.array_equal(mem._storage._codes,
+                                   six._storage._codes))
+            quant_fields.update({
+                "shard_native_build_s": six.stats["build_seconds"],
+                "shard_native_recall_at_10": six.stats.get("recall_at_10"),
+                "shard_native_index_bytes": six.stats["index_bytes"],
+                "shard_native_parity": parity,
+            })
+            log(f"shard-native int8 build: "
+                f"{six.stats['build_seconds']}s, recall@10 "
+                f"{six.stats.get('recall_at_10')}, parity={parity}")
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
     # operating-point latency: the half-capacity offered row (module doc)
     op = offered_rows[0]
     speedup = (round(exact_pq["p50_ms"] / op["p50_ms"], 2)
@@ -452,6 +559,9 @@ def main() -> int:
         "ann_centroids": ann_stats["centroids"],
         "ann_nprobe": ann_stats["nprobe"],
         "ann_build_s": ann_stats["build_seconds"],
+        "ann_index_bytes": ann_stats.get("index_bytes"),
+        "ann_bytes_per_vector": ann_stats.get("bytes_per_vector"),
+        **quant_fields,
         # the ISSUE-10 acceptance headline: the batched ANN arm's
         # operating-point p50 vs the exact PER-QUERY p50 it replaces
         # (>= 10x at recall@10 >= 0.95)
