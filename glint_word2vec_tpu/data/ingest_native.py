@@ -25,6 +25,7 @@ from typing import Optional
 import numpy as np
 
 from glint_word2vec_tpu.train.faults import maybe_fail_ingest, retry_io
+from glint_word2vec_tpu.lockcheck import make_lock
 
 logger = logging.getLogger("glint_word2vec_tpu")
 
@@ -33,7 +34,7 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "native", "ingest.cpp")
 _LIB = os.path.join(os.path.dirname(_SRC), "libingest.so")
 
-_lock = threading.Lock()
+_lock = make_lock("data.ingest_native.load")
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 

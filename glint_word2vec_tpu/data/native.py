@@ -22,6 +22,7 @@ import time
 from typing import Optional, Tuple
 
 import numpy as np
+from glint_word2vec_tpu.lockcheck import make_lock
 
 logger = logging.getLogger("glint_word2vec_tpu")
 
@@ -30,7 +31,7 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "native", "pairgen.cpp")
 _LIB = os.path.join(os.path.dirname(_SRC), "libpairgen.so")
 
-_lock = threading.Lock()
+_lock = make_lock("data.native.load")
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 

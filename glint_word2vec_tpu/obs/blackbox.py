@@ -45,6 +45,7 @@ from collections import deque
 from typing import Any, Dict, Optional
 
 from glint_word2vec_tpu.obs.schema import SCHEMA_VERSION
+from glint_word2vec_tpu.lockcheck import make_rlock
 
 logger = logging.getLogger("glint_word2vec_tpu")
 
@@ -69,7 +70,7 @@ class FlightRecorder:
         # process would die dumpless — the exact failure this class exists
         # to prevent. (Same rule in phases/spans/sink: every lock the
         # handler's dump path can touch is reentrant.)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("obs.blackbox")
         # dispatches dominate volume (one per round); heartbeats arrive at
         # 1/heartbeat_every_steps of that and events are rarer still — the
         # smaller rings keep the dump proportioned without more knobs

@@ -38,6 +38,7 @@ from __future__ import annotations
 import math
 import threading
 from typing import Dict, List, Optional
+from glint_word2vec_tpu.lockcheck import make_rlock
 
 # quarter-octave log2 buckets over 2^-20 .. 2^6 seconds (~1 µs .. 64 s);
 # same bucketing discipline as obs/probe.py's norm histogram
@@ -94,7 +95,7 @@ class PhaseAccumulator:
         # bytecode boundary) snapshots these histograms — a plain Lock held
         # by the interrupted add() would deadlock the handler
         # (obs/blackbox.py has the full rationale)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("obs.phases")
         self._phases: Dict[str, _Phase] = {p: _Phase() for p in PHASES}
 
     def configure(self, enabled: bool) -> None:

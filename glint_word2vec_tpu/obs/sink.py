@@ -36,6 +36,7 @@ import time
 from typing import Optional
 
 from glint_word2vec_tpu.obs.schema import SCHEMA_VERSION
+from glint_word2vec_tpu.lockcheck import make_rlock
 
 logger = logging.getLogger("glint_word2vec_tpu")
 
@@ -54,7 +55,7 @@ class TelemetrySink:
         # record from the main-thread signal handler — a plain Lock held by
         # that same thread's interrupted emit() would deadlock the handler
         # (obs/blackbox.py has the full rationale)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("obs.sink")
         self._file = None
         self._size = 0
         self._dead = False

@@ -40,6 +40,7 @@ import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+from glint_word2vec_tpu.lockcheck import make_lock
 
 
 class SloObjectives:
@@ -116,7 +117,7 @@ class SloTracker:
     def __init__(self, objectives: Optional[SloObjectives] = None,
                  ring: int = 65536):
         self.objectives = objectives or SloObjectives()
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.slo")
         # (mono_s, answered, within_latency) — bounded: at the ring size a
         # million-QPS tier still holds the full short window at drill scale,
         # and the TOTAL counters below never lose history

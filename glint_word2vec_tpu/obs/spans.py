@@ -30,6 +30,7 @@ import json
 import threading
 import time
 from typing import Dict, List, Optional
+from glint_word2vec_tpu.lockcheck import make_rlock
 
 
 class _NoopSpan:
@@ -85,7 +86,7 @@ class Tracer:
         # RLock: the flight recorder's SIGTERM dump (main thread) reads
         # span_summary() — a plain Lock held by the interrupted thread's
         # own _record() would deadlock the handler (obs/blackbox.py)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("obs.spans")
         # deque(maxlen): appending past capacity drops the OLDEST in O(1) —
         # the tail of a long run is what a hang/slowdown investigation needs
         self._events: "deque" = deque(maxlen=self.max_events)

@@ -288,11 +288,17 @@ class StatusServer:
                     "(/status.json, /metrics, /healthz)", self.port)
         return self
 
-    def stop(self) -> None:
+    def stop(self) -> int:
+        """Returns the number of leaked threads (0/1)."""
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        leaked = 0
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+            if t.is_alive():
+                leaked = 1
+                logger.warning("statusd server thread leaked (join timeout)")
+        return leaked

@@ -35,6 +35,7 @@ import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
+from glint_word2vec_tpu.lockcheck import make_condition
 
 logger = logging.getLogger("glint_word2vec_tpu")
 
@@ -138,7 +139,7 @@ class BatchingScheduler:
         self._batch_observer = batch_observer
         self._name = name
         self._q: collections.deque = collections.deque()
-        self._cv = threading.Condition()
+        self._cv = make_condition("serve.batcher.cv")
         self._stopping = False
         self._thread: Optional[threading.Thread] = None
         # counters (all mutated under _cv)
@@ -166,18 +167,24 @@ class BatchingScheduler:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self) -> int:
         """Drain-and-stop: requests already admitted are still served (the
         worker keeps batching until the queue is empty), new submits are
-        refused."""
+        refused. Returns the number of leaked threads (1 when the worker
+        misses the join bound) so close() paths can surface it in stats."""
         with self._cv:
             if self._stopping:
-                return
+                return 0
             self._stopping = True
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            self._thread = None
+        leaked = 0
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=30)
+            if t.is_alive():
+                leaked = 1
+                logger.warning("batcher worker thread leaked (join timeout)")
+        return leaked
 
     # -- client side -------------------------------------------------------------------
 
@@ -210,7 +217,12 @@ class BatchingScheduler:
         error in the caller's thread."""
         if not ticket.done.wait(timeout):
             raise TimeoutError(f"request not served within {timeout:g}s")
-        self._latencies.append(time.monotonic() - ticket.enqueued)
+        # under _cv like every other ring access: a lock-free append races
+        # stats()'s iteration — deque.append is atomic, but iterating a
+        # deque another thread appends to raises RuntimeError (the PR 12
+        # class; graftlint R11 holds every access to the same lock)
+        with self._cv:
+            self._latencies.append(time.monotonic() - ticket.enqueued)
         if ticket.error is not None:
             raise ticket.error
         return ticket.result
@@ -354,7 +366,8 @@ class BatchingScheduler:
                                     if self._batch_s_ewma is not None
                                     else None),
             }
-        lats = sorted(self._latencies)
+            lats = list(self._latencies)  # snapshot under _cv; sort outside
+        lats.sort()
         if lats:
             def pct(p: float) -> float:
                 return round(

@@ -77,6 +77,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from glint_word2vec_tpu.obs.slo import SloObjectives, SloTracker, flatten_burn
+from glint_word2vec_tpu.lockcheck import make_lock
 from glint_word2vec_tpu.obs.trace import (
     clock_anchor,
     new_span_id,
@@ -153,7 +154,7 @@ class CircuitBreaker:
         self.fail_threshold = int(fail_threshold)
         self.reset_s = float(reset_s)
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.breaker")
         self._state = self.CLOSED
         self._consecutive = 0
         self._opened_at = 0.0
@@ -165,17 +166,33 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
-    def _move(self, to: str, reason: str) -> None:
-        # under self._lock
+    def _move(self, to: str, reason: str):
+        # under self._lock; returns the (from, to, reason) triple the caller
+        # hands to _fire_transition AFTER releasing — the callback emits
+        # telemetry (sink I/O), and holding the breaker lock across it made
+        # every state change a breaker→sink held-while-blocking window
+        # (graftrace: docs/static-analysis.md layer 4)
         frm, self._state = self._state, to
         self.transitions.append((frm, to, reason))
+        return (frm, to, reason)
+
+    def _fire_transition(self, t) -> None:
+        if t is None:
+            return
         cb = self._on_transition
         if cb is not None:
             try:
-                cb(frm, to, reason)
+                cb(*t)
             except Exception:  # noqa: BLE001 — telemetry is best-effort
                 logger.warning("breaker transition callback failed",
                                exc_info=True)
+
+    def transitions_snapshot(self) -> list:
+        """Transition history copied under the lock — iterating the deque
+        while a breaker thread appends raises RuntimeError (the PR 12
+        class)."""
+        with self._lock:
+            return list(self.transitions)
 
     def allows_traffic(self) -> bool:
         """Client traffic goes only to CLOSED breakers; OPEN/HALF_OPEN
@@ -194,34 +211,38 @@ class CircuitBreaker:
     def begin_probe(self) -> bool:
         """OPEN (cooldown elapsed) → HALF_OPEN; returns False if another
         trial already holds the half-open slot."""
+        t = None
         with self._lock:
             if (self._state == self.OPEN
                     and time.monotonic() - self._opened_at >= self.reset_s):
-                self._move(self.HALF_OPEN, "cooldown elapsed, trial probe")
-                return True
-            return False
+                t = self._move(self.HALF_OPEN, "cooldown elapsed, trial probe")
+        self._fire_transition(t)
+        return t is not None
 
     def record_success(self) -> None:
+        t = None
         with self._lock:
             self._consecutive = 0
             if self._state == self.HALF_OPEN:
-                self._move(self.CLOSED, "trial probe succeeded")
+                t = self._move(self.CLOSED, "trial probe succeeded")
+        self._fire_transition(t)
 
     def record_failure(self, reason: str = "") -> None:
+        t = None
         with self._lock:
             now = time.monotonic()
             if self._state == self.HALF_OPEN:
                 self._opened_at = now
-                self._move(self.OPEN, f"trial failed: {reason}"[:200])
-                return
-            if self._state == self.CLOSED:
+                t = self._move(self.OPEN, f"trial failed: {reason}"[:200])
+            elif self._state == self.CLOSED:
                 self._consecutive += 1
                 if self._consecutive >= self.fail_threshold:
                     self._opened_at = now
-                    self._move(
+                    t = self._move(
                         self.OPEN,
                         f"{self._consecutive} consecutive failures "
                         f"(last: {reason})"[:200])
+        self._fire_transition(t)
 
 
 # ---------------------------------------------------------------------------
@@ -273,12 +294,13 @@ class SubprocessReplica:
         self.telemetry_path = telemetry_path
         self._proc: Optional[subprocess.Popen] = None
         self._reader: Optional[threading.Thread] = None
-        self._wlock = threading.Lock()
-        self._plock = threading.Lock()
+        self._wlock = make_lock("fleet.replica.write")
+        self._plock = make_lock("fleet.replica.pending")
         self._pending: Dict[int, FleetTicket] = {}
         self._next_id = 0
         self.ready = threading.Event()
         self.restarts = 0
+        self.leaked_threads = 0
 
     # -- lifecycle --------------------------------------------------------------------
 
@@ -352,11 +374,20 @@ class SubprocessReplica:
             except OSError:
                 pass
 
-    def close(self) -> None:
+    def close(self) -> int:
+        """Kill the child and join the stdout reader with a bounded
+        timeout; a reader that misses the bound is counted in
+        ``leaked_threads`` (surfaced per-replica by the router's stats).
+        Idempotent — a second close re-reports the same count."""
         self.kill()
-        if self._reader is not None:
-            self._reader.join(timeout=10)
-            self._reader = None
+        r, self._reader = self._reader, None
+        if r is not None:
+            r.join(timeout=10)
+            if r.is_alive():
+                self.leaked_threads += 1
+                logger.warning("%s: reader thread leaked (join timeout)",
+                               self.name)
+        return self.leaked_threads
 
     # -- request/response -------------------------------------------------------------
 
@@ -437,6 +468,7 @@ class InProcessReplica:
         self.service = service
         self._next_id = 0
         self.restarts = 0
+        self.leaked_threads = 0
 
     def start(self) -> "InProcessReplica":
         return self
@@ -504,10 +536,11 @@ class InProcessReplica:
         pass
 
     def kill(self) -> None:
-        self.service.close()
+        self.leaked_threads = self.service.close()
 
-    def close(self) -> None:
-        self.service.close()
+    def close(self) -> int:
+        self.leaked_threads = self.service.close()
+        return self.leaked_threads
 
 
 def _error_response(e: BaseException) -> dict:
@@ -576,13 +609,16 @@ class ReplicaSet:
         return cls([InProcessReplica(f"r{i}", s)
                     for i, s in enumerate(services)], can_respawn=False)
 
-    def close(self) -> None:
+    def close(self) -> int:
+        """Close every replica; returns the total leaked-thread count."""
+        leaked = 0
         for r in self.replicas:
             try:
-                r.close()
+                leaked += r.close() or 0
             except Exception:  # noqa: BLE001 — best-effort teardown
                 logger.warning("replica %s close failed", r.name,
                                exc_info=True)
+        return leaked
 
 
 # ---------------------------------------------------------------------------
@@ -668,7 +704,7 @@ class FleetRouter:
         self._saturation_floor_s = float(saturation_floor_s)
         self._drain_timeout_s = float(drain_timeout_s)
         self._reload_timeout_s = float(reload_timeout_s)
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.router")
         self._rr = 0  # round-robin tie-break counter
         # jitter source: seeded (R2); per-router decorrelation is the point
         self._rng = np.random.default_rng(
@@ -694,6 +730,7 @@ class FleetRouter:
         self._lat_count = 0
         self._p99_s: Optional[float] = None
         self._closed = False
+        self._leaked_threads = 0
         self._sink = None
         self._statusd = None
         self._slo = SloTracker(slo)
@@ -1278,7 +1315,7 @@ class FleetRouter:
     def breaker_transitions(self, name: str) -> List[Tuple[str, str, str]]:
         for r in self._replicas:
             if r.name == name:
-                return list(r.breaker.transitions)
+                return r.breaker.transitions_snapshot()
         raise KeyError(name)
 
     def stats(self) -> Dict[str, Any]:
@@ -1298,6 +1335,7 @@ class FleetRouter:
             }
         replicas: Dict[str, Any] = {}
         healthy = degraded = 0
+        leaked = self._leaked_threads
         for r in self._replicas:
             alive = r.handle.alive()
             closed = r.breaker.state == CircuitBreaker.CLOSED
@@ -1313,12 +1351,16 @@ class FleetRouter:
                 "reloads": r.reloads,
                 "drained_reloads": r.drained_reloads,
                 "restarts": r.handle.restarts,
+                "leaked_threads": getattr(r.handle, "leaked_threads", 0),
                 "publish_sig": r.publish_sig,
                 "stats": r.stats_cache,
             }
+        for rs in replicas.values():
+            leaked += rs["leaked_threads"]
         snap["replicas"] = replicas
         snap["healthy"] = healthy
         snap["degraded"] = degraded
+        snap["leaked_threads"] = leaked
         snap["slo"] = self._slo.snapshot()
         with self._lock:  # same mutation-during-sort hazard as _note_latency
             lats = list(self._latencies)
@@ -1368,8 +1410,11 @@ class FleetRouter:
         self._closed = True
         self._stop.set()
         self._prober.join(timeout=30)
+        if self._prober.is_alive():
+            self._leaked_threads += 1
+            logger.warning("fleet prober thread leaked (join timeout)")
         if self._statusd is not None:
-            self._statusd.stop()
+            self._leaked_threads += self._statusd.stop()
         if self._sink is not None:
             with self._lock:
                 q, f = self.queries, self.failures
@@ -1379,7 +1424,7 @@ class FleetRouter:
             self._sink.emit("fleet_end", queries=q, failures=f)
             self._sink.close()
         if close_replicas:
-            self._set.close()
+            self._leaked_threads += self._set.close()
 
     def __enter__(self) -> "FleetRouter":
         return self

@@ -37,6 +37,7 @@ import time
 from typing import Any, Callable, Iterator, Optional, Tuple
 
 import numpy as np
+from glint_word2vec_tpu.lockcheck import make_lock
 
 logger = logging.getLogger("glint_word2vec_tpu")
 
@@ -162,7 +163,7 @@ class ServingHandle:
     """Atomically swappable (model, index) with lease-counted release."""
 
     def __init__(self, model, index=None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.handle")
         self._current: Optional[_Slot] = _Slot(model, index)
         self.models_released = 0
         self.swaps = 0
@@ -270,11 +271,18 @@ class CheckpointWatcher:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self) -> int:
+        """Returns the number of leaked threads (0/1)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            self._thread = None
+        leaked = 0
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=30)
+            if t.is_alive():
+                leaked = 1
+                logger.warning("checkpoint watcher thread leaked "
+                               "(join timeout)")
+        return leaked
 
     def _run(self) -> None:
         while not self._stop.wait(self._poll_s):

@@ -145,6 +145,7 @@ class EmbeddingService:
         self._watcher = None
         self._handle = None
         self._closed = False
+        self._leaked_threads = 0
         self._blackbox = None
         self._span_emitter = None
         self._dispatch_count = 0
@@ -543,6 +544,7 @@ class EmbeddingService:
         snap["vocab_change_reloads"] = self.vocab_change_reloads
         snap["models_released"] = self._handle.models_released
         snap["load_seconds"] = round(self._load_seconds, 3)
+        snap["leaked_threads"] = self._leaked_threads
         # the served publish generation (None for in-memory models): the
         # fleet health prober compares this against the on-disk signature —
         # a replica a generation behind its peers is DEGRADED, not dead
@@ -572,19 +574,21 @@ class EmbeddingService:
             **{k: s[k] for k in ("latency_ms", "occupancy_mean", "ann")
                if s.get(k) is not None})
 
-    def close(self) -> None:
+    def close(self) -> int:
         """Drain the batcher, stop the watcher/statusd, release the model,
         close the sink. Idempotent, and safe on a partially-initialized
-        service (the failed-__init__ cleanup path calls this)."""
+        service (the failed-__init__ cleanup path calls this). Returns the
+        number of owned threads that missed their join bound (also
+        surfaced as ``leaked_threads`` in :meth:`stats`)."""
         if self._closed:
-            return
+            return self._leaked_threads
         self._closed = True
         if self._watcher is not None:
-            self._watcher.stop()
+            self._leaked_threads += self._watcher.stop()
         if self._batcher is not None:
-            self._batcher.stop()
+            self._leaked_threads += self._batcher.stop()
         if self._statusd is not None:
-            self._statusd.stop()
+            self._leaked_threads += self._statusd.stop()
         if self._sink is not None:
             if self._batcher is not None:
                 s = self._batcher.stats()
@@ -596,6 +600,7 @@ class EmbeddingService:
                 self._handle.stop()
             else:
                 self._handle.detach()
+        return self._leaked_threads
 
     def __enter__(self) -> "EmbeddingService":
         return self

@@ -1,0 +1,33 @@
+"""R10 bad fixture: the PR 9 handler-deadlock shape. The SIGTERM handler's
+call closure acquires the non-reentrant 'ring' lock that record() — a
+normal path, running on the thread the signal interrupts — also holds. If
+the signal lands inside record()'s critical section the handler blocks on
+a lock its own thread owns, forever."""
+import signal
+
+from glint_word2vec_tpu.lockcheck import make_lock
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = make_lock("ring")
+        self._events = []
+
+    def record(self, e):
+        with self._lock:
+            self._events.append(e)
+
+    def dump(self):
+        with self._lock:
+            return list(self._events)
+
+
+class Daemon:
+    def __init__(self):
+        self._rec = Recorder()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    def _on_sigterm(self, signum, frame):
+        self._rec.dump()

@@ -1,0 +1,12 @@
+"""Fixture lock registry for the R10 handler-safety pair (good twin)."""
+import threading
+
+LOCK_TABLE = {
+    "ring": {"rank": 10, "kind": "lock",
+             "site": "glint_word2vec_tpu/svc.py:Recorder.__init__",
+             "owner": "fixture recorder"},
+}
+
+
+def make_lock(name):
+    return threading.Lock()

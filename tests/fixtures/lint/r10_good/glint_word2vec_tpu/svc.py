@@ -1,0 +1,35 @@
+"""R10 good twin: the PR 9 FIX shape. dump() takes include_stats and only
+touches the lock inside `if include_stats:`; the handler passes the literal
+include_stats=False, so the rule's one-deep constant propagation prunes the
+locked branch and the handler closure is lock-free."""
+import signal
+
+from glint_word2vec_tpu.lockcheck import make_lock
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = make_lock("ring")
+        self._events = []
+
+    def record(self, e):
+        with self._lock:
+            self._events.append(e)
+
+    def dump(self, include_stats=True):
+        out = {"n": -1}
+        if include_stats:
+            with self._lock:
+                out["n"] = len(self._events)
+        return out
+
+
+class Daemon:
+    def __init__(self):
+        self._rec = Recorder()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    def _on_sigterm(self, signum, frame):
+        self._rec.dump(include_stats=False)

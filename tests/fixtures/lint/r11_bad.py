@@ -1,0 +1,21 @@
+"""R11 bad fixture: the PR 12 latency-ring race, verbatim shape. The worker
+thread appends to the deque lock-free while stats() sorts it — deque
+iteration raises RuntimeError on concurrent mutation, so BOTH sites are
+findings (a lock-free append plus a locked read still races)."""
+import collections
+import threading
+
+
+class LatencyRing:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latencies = collections.deque(maxlen=512)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            self._latencies.append(0.0)
+
+    def stats(self):
+        return sorted(self._latencies)

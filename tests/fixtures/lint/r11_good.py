@@ -1,0 +1,29 @@
+"""R11 good twin: every whole-collection access of the shared ring holds
+the same lock, and the one lock-free consumer goes through a documented
+snapshot helper (copy under the lock, sort outside)."""
+import collections
+import threading
+
+
+class LatencyRing:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latencies = collections.deque(maxlen=512)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._latencies.append(0.0)
+
+    def snapshot_latencies(self):
+        """Copy under the lock so callers may sort/iterate freely — the
+        deque itself is never exposed to a second thread."""
+        with self._lock:
+            return list(self._latencies)
+
+    def stats(self):
+        lats = self.snapshot_latencies()
+        lats.sort()
+        return lats

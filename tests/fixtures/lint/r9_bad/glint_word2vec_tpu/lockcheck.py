@@ -1,0 +1,19 @@
+"""Fixture lock registry (mirrors the real lockcheck module's contract:
+LOCK_TABLE is a pure literal the drift gate can ast.literal_eval)."""
+import threading
+
+LOCK_TABLE = {
+    "outer": {"rank": 10, "kind": "lock",
+              "site": "glint_word2vec_tpu/pipe.py:Pipe.__init__",
+              "owner": "fixture pipe"},
+    "inner": {"rank": 20, "kind": "lock",
+              "site": "glint_word2vec_tpu/pipe.py:Pipe.__init__",
+              "owner": "fixture pipe"},
+    "ghost": {"rank": 30, "kind": "lock",
+              "site": "glint_word2vec_tpu/gone.py:Gone.__init__",
+              "owner": "never constructed — the stale-entry drift case"},
+}
+
+
+def make_lock(name):
+    return threading.Lock()

@@ -1,0 +1,24 @@
+"""R9 bad fixture: one rank inversion (which also closes a cycle), one raw
+primitive construction, and one unregistered factory call."""
+import threading
+
+from glint_word2vec_tpu.lockcheck import make_lock
+
+_raw = threading.Lock()
+
+
+class Pipe:
+    def __init__(self):
+        self._outer = make_lock("outer")
+        self._inner = make_lock("inner")
+        self._rogue = make_lock("unregistered")
+
+    def forward(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+    def backward(self):
+        with self._inner:
+            with self._outer:
+                pass

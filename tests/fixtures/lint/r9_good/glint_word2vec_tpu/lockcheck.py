@@ -1,0 +1,17 @@
+"""Fixture lock registry — good twin: every entry has a live construction
+site whose path:qualname matches, and ranks strictly increase along the one
+nesting in the tree."""
+import threading
+
+LOCK_TABLE = {
+    "outer": {"rank": 10, "kind": "lock",
+              "site": "glint_word2vec_tpu/pipe.py:Pipe.__init__",
+              "owner": "fixture pipe"},
+    "inner": {"rank": 20, "kind": "lock",
+              "site": "glint_word2vec_tpu/pipe.py:Pipe.__init__",
+              "owner": "fixture pipe"},
+}
+
+
+def make_lock(name):
+    return threading.Lock()

@@ -1,0 +1,14 @@
+"""R9 good twin: registered factory constructions only, and the single
+nesting acquires in strictly increasing rank order (outer=10 -> inner=20)."""
+from glint_word2vec_tpu.lockcheck import make_lock
+
+
+class Pipe:
+    def __init__(self):
+        self._outer = make_lock("outer")
+        self._inner = make_lock("inner")
+
+    def forward(self):
+        with self._outer:
+            with self._inner:
+                pass

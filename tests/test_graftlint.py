@@ -17,6 +17,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tools.graftlint import engine  # noqa: E402
+from tools.graftlint.concurrency import (  # noqa: E402
+    R1Staleness, R9LockOrder, R10HandlerSafety)
 from tools.graftlint.rules import R8RefusalParity  # noqa: E402
 
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
@@ -32,6 +34,7 @@ _VPATH = {
     "R5": "glint_word2vec_tpu/data/somefile.py",
     "R6": "glint_word2vec_tpu/train/trainer.py",
     "R7": "bench.py",
+    "R11": "glint_word2vec_tpu/serve/somefile.py",
 }
 
 
@@ -244,3 +247,133 @@ def test_fixtures_are_out_of_lint_scope():
                for p in engine.iter_source_files(REPO)}
     assert not any(p.startswith("tests/") for p in scanned)
     assert "tools/graftlint/rules.py" not in scanned  # rules discuss patterns
+
+
+# ---------------------------------------------------------------------------
+# graftrace (layer 4, ISSUE 20): R9/R10 repo-rule fixture pairs + the R1
+# staleness gate. R11 rides the parametrized per-file pair above.
+# ---------------------------------------------------------------------------
+
+def test_r9_fires_on_bad_pair_and_not_on_good_pair():
+    rule = R9LockOrder()
+    bad = rule.check_repo(os.path.join(FIXTURES, "r9_bad"))
+    msgs = [f.message for f in bad if f.rule == "R9"]
+    # the inversion: 'outer' (rank 10) taken while holding 'inner' (rank 20)
+    assert any("inversion" in m and "'outer'" in m and "'inner'" in m
+               for m in msgs), bad
+    # the same pair of edges closes a cycle — reported explicitly so a
+    # re-ranking "fix" that leaves a loop is still caught
+    assert any("cycle" in m for m in msgs), bad
+    # registry drift: raw primitive, unregistered factory name, stale entry
+    assert any("raw threading.Lock()" in m for m in msgs), bad
+    assert any("'unregistered'" in m and "not registered" in m
+               for m in msgs), bad
+    assert any("stale registry entry 'ghost'" in m for m in msgs), bad
+    good = rule.check_repo(os.path.join(FIXTURES, "r9_good"))
+    assert not good, good
+
+
+def test_r10_fires_on_pr9_shape_and_not_on_pr9_fix():
+    """Bad twin is the PR 9 handler-deadlock shape (handler closure reaches
+    a non-reentrant lock normal paths hold); good twin is the PR 9 FIX
+    (literal include_stats=False prunes the locked branch)."""
+    rule = R10HandlerSafety()
+    bad = rule.check_repo(os.path.join(FIXTURES, "r10_bad"))
+    assert any(f.rule == "R10" and "'ring'" in f.message
+               and "deadlock" in f.message for f in bad), bad
+    good = rule.check_repo(os.path.join(FIXTURES, "r10_good"))
+    assert not good, good
+
+
+def test_r9_registry_site_must_match_construction_site():
+    """Moving a construction without updating the registry's site is drift:
+    flag it on a copy of the good pair with a wrong site."""
+    import shutil
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        shutil.copytree(os.path.join(FIXTURES, "r9_good"), td,
+                        dirs_exist_ok=True)
+        lc = os.path.join(td, "glint_word2vec_tpu", "lockcheck.py")
+        with open(lc, "r", encoding="utf-8") as f:
+            src = f.read()
+        moved = src.replace(
+            '"site": "glint_word2vec_tpu/pipe.py:Pipe.__init__",\n'
+            '              "owner": "fixture pipe"},\n    "inner"',
+            '"site": "glint_word2vec_tpu/old.py:Old.__init__",\n'
+            '              "owner": "fixture pipe"},\n    "inner"')
+        assert moved != src, "fixture registry refactored — update anchor"
+        with open(lc, "w", encoding="utf-8") as f:
+            f.write(moved)
+        out = R9LockOrder().check_repo(td)
+        assert any("registered at" in f.message and "constructed at"
+                   in f.message for f in out), out
+
+
+def test_repo_rule_findings_honor_suppressions():
+    """R9 is a repo rule — the engine only applies suppression directives to
+    per-file rules, so the concurrency rules re-apply them per flagged file.
+    A justified directive on the raw-construction line must suppress it."""
+    import shutil
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        shutil.copytree(os.path.join(FIXTURES, "r9_good"), td,
+                        dirs_exist_ok=True)
+        pipe = os.path.join(td, "glint_word2vec_tpu", "pipe.py")
+        with open(pipe, "a", encoding="utf-8") as f:
+            f.write("\nimport threading\n_x = threading.Lock()"
+                    "  # graftlint: disable=R9 -- fixture-sanctioned raw\n")
+        out = R9LockOrder().check_repo(td)
+        raws = [f for f in out if "raw threading.Lock()" in f.message]
+        assert raws and all(f.suppressed and f.justification
+                            for f in raws), out
+
+
+def test_r1_staleness_fires_on_dead_entries_and_real_allowlist_is_live():
+    """ISSUE 20 satellite: an allowlist entry whose (path, qualname) no
+    longer resolves is a finding — on the REAL tree, with the REAL
+    allowlist, there must be none (every blessing points at a live def)."""
+    assert not R1Staleness().check_repo(REPO)
+    stale = R1Staleness(allowlist=[
+        ("glint_word2vec_tpu/serve/batcher.py",
+         "BatchingScheduler.no_such_method"),
+        ("glint_word2vec_tpu/no/such/file.py", "whatever"),
+    ])
+    out = stale.check_repo(REPO)
+    msgs = " ".join(f.message for f in out)
+    assert "no_such_method" in msgs and "cannot be parsed/found" in msgs, out
+
+
+def test_r11_snapshot_escape_requires_name_and_docstring():
+    """The documented-snapshot escape is narrow: 'snapshot' in the METHOD
+    NAME plus a docstring exempts its accesses; the same unguarded read in
+    a method missing either leg stays flagged."""
+    tmpl = """
+import collections
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = collections.deque()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._ring.append(1)
+
+    def {name}(self):
+        {doc}return list(self._ring)
+"""
+    blessed = tmpl.format(
+        name="snapshot_ring",
+        doc='\"\"\"Callers tolerate a stale copy; GC owns the old one.'
+            '\"\"\"\n        ')
+    out = engine.lint_text(blessed, _VPATH["R11"])
+    assert not [f for f in out if f.rule == "R11"], out
+    for name, doc in [("grab", '\"\"\"Some docstring.\"\"\"\n        '),
+                      ("snapshot_ring", "")]:
+        out = engine.lint_text(tmpl.format(name=name, doc=doc), _VPATH["R11"])
+        assert any(f.rule == "R11" for f in out), (name, out)

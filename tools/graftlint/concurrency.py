@@ -1,0 +1,924 @@
+"""graftrace static half: the R9–R11 lock-discipline rules + registry gates.
+
+Two real concurrency bugs shipped through review (docs/static-analysis.md
+layer 4): the PR 9 SIGTERM-handler deadlock (handler blocked on a plain
+``Lock`` held by the thread it interrupted) and the PR 12 latency-ring race
+(sorting a deque another thread appends to). These rules machine-check the
+threading discipline the same way R1–R8 machine-check the AST idioms:
+
+- **R9 lock-order**: every lock is constructed through
+  ``glint_word2vec_tpu.lockcheck`` with a registered rank
+  (:data:`lockcheck.LOCK_TABLE` — parsed here as a pure literal, the same
+  contract as the graftcheck knob registry). The cross-module acquisition
+  graph is built from ``with``/``.acquire()`` sites resolved through
+  ``self.`` attributes plus a bounded call closure; any edge that does not
+  strictly increase rank, any cycle, and any reentrant acquisition of a
+  non-reentrant kind is a finding. Registry drift (unregistered
+  construction, raw ``threading.Lock()`` in scanned code, stale or moved
+  registry entries) fails the same rule.
+- **R10 signal-handler safety**: the call closure of every installed signal
+  handler (``signal.signal(SIG, h)``) may not acquire a non-reentrant lock
+  that non-handler code also holds — the PR 9 bug, now structurally
+  impossible. The closure walk propagates literal boolean keyword arguments
+  one call deep (pruning ``if param:`` bodies), because the PR 9 fix itself
+  is such a guard: ``dump_blackbox(include_stats=False)`` exists precisely
+  to keep the batcher's non-reentrant condition off the handler path.
+- **R11 shared-mutable discipline** (per-file): in a thread-owning class,
+  every whole-collection access (mutation or ``sorted``/``list``/iteration
+  read) of a shared deque/list/dict attribute must hold the same lock, or
+  live in a documented snapshot helper (name/docstring says "snapshot").
+  The PR 12 race is the bad fixture.
+
+Repo-rule findings here honor the standard suppression syntax (directive
+with justification on the flagged line or the line above) — the engine only
+applies suppressions to per-file rules, so the repo rules in this module
+re-apply them per flagged file themselves.
+
+``R1Staleness`` rides along (ISSUE 20 satellite): an R1 allowlist entry
+whose (path, qualname) no longer resolves to a def is a finding — stale
+thread-owner blessings used to rot silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import Finding, _apply_suppressions, iter_source_files
+from tools.graftlint.rules import _name_of
+
+_LIB = "glint_word2vec_tpu/"
+_LOCKCHECK = _LIB + "lockcheck.py"
+_FACTORIES = {"make_lock": "lock", "make_rlock": "rlock",
+              "make_condition": "condition"}
+_PRIMITIVES = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+_CLOSURE_DEPTH = 10
+
+
+def _is_primitive_ctor(call: ast.Call) -> Optional[str]:
+    """'lock'/'rlock'/'condition' if this is a raw threading primitive
+    construction (threading.Lock() or bare Lock())."""
+    name = _name_of(call.func)
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _PRIMITIVES and name in (tail, "threading." + tail):
+        return _PRIMITIVES[tail]
+    return None
+
+
+def _factory_call(call: ast.Call) -> Optional[Tuple[str, Optional[str]]]:
+    """(kind, registered name or None) for lockcheck factory calls."""
+    tail = _name_of(call.func).rsplit(".", 1)[-1]
+    if tail not in _FACTORIES:
+        return None
+    name = None
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+            call.args[0].value, str):
+        name = call.args[0].value
+    return _FACTORIES[tail], name
+
+
+class _Fn:
+    """One function/method with everything the closure walk needs."""
+
+    __slots__ = ("node", "path", "cls", "name", "params")
+
+    def __init__(self, node: ast.AST, path: str, cls: Optional[str],
+                 name: str) -> None:
+        self.node = node
+        self.path = path
+        self.cls = cls
+        self.name = name
+        args = getattr(node, "args", None)
+        self.params = ({a.arg for a in args.args} | {a.arg for a in
+                       args.kwonlyargs} if args is not None else set())
+
+    @property
+    def key(self) -> Tuple[str, Optional[str], str]:
+        return (self.path, self.cls, self.name)
+
+    @property
+    def label(self) -> str:
+        return f"{self.path}:{(self.cls + '.') if self.cls else ''}{self.name}"
+
+
+class _TreeIndex:
+    """Whole-tree concurrency index: the lock registry, every construction
+    site, per-class lock/typed attributes, and the function map the
+    closure walk resolves calls through."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.registry: Dict[str, dict] = {}
+        self.registry_err: Optional[str] = None
+        self.files: Dict[str, Tuple[ast.Module, List[str]]] = {}
+        # ClassName -> (path, ClassDef); name collisions -> None (ambiguous)
+        self.classes: Dict[str, Optional[Tuple[str, ast.ClassDef]]] = {}
+        self.fns: Dict[Tuple[str, Optional[str], str], _Fn] = {}
+        self.attr_locks: Dict[Tuple[str, str], str] = {}   # (cls, attr) -> lock
+        self.mod_locks: Dict[Tuple[str, str], str] = {}    # (path, var) -> lock
+        self.attr_types: Dict[Tuple[str, str], str] = {}   # (cls, attr) -> Cls
+        # (path, qualname, lockname, kind, lineno)
+        self.construct_sites: List[Tuple[str, str, Optional[str], str, int]] = []
+        self.raw_sites: List[Tuple[str, str, str, int]] = []
+        self._load()
+
+    # -- loading ----------------------------------------------------------------------
+
+    def _load(self) -> None:
+        for abspath in iter_source_files(self.root):
+            rel = os.path.relpath(abspath, self.root).replace(os.sep, "/")
+            try:
+                with open(abspath, "r", encoding="utf-8") as f:
+                    text = f.read()
+                tree = ast.parse(text)
+            except (OSError, SyntaxError):
+                continue  # the engine reports AST findings; nothing here
+            self.files[rel] = (tree, text.splitlines())
+        lc = self.files.get(_LOCKCHECK)
+        if lc is None:
+            self.registry_err = f"{_LOCKCHECK} not found — no lock registry"
+        else:
+            self._parse_registry(lc[0])
+        for rel, (tree, _) in self.files.items():
+            self._index_module(rel, tree)
+        # second pass: typed attributes need the full class map
+        for rel, (tree, _) in self.files.items():
+            self._index_attr_types(rel, tree)
+
+    def _parse_registry(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "LOCK_TABLE"):
+                try:
+                    table = ast.literal_eval(node.value)
+                except ValueError:
+                    self.registry_err = (
+                        "LOCK_TABLE is not a pure literal — entries built by "
+                        "code are invisible to the drift gate")
+                    return
+                self.registry = dict(table)
+                return
+        self.registry_err = "LOCK_TABLE not found in lockcheck.py"
+
+    def _index_module(self, rel: str, tree: ast.Module) -> None:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def qualname(node: ast.AST) -> str:
+            parts: List[str] = []
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    parts.append(cur.name)
+                cur = parents.get(cur)
+            return ".".join(reversed(parts)) or "<module>"
+
+        def enclosing_class(node: ast.AST) -> Optional[str]:
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    return cur.name
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # nested def inside a method still belongs to the class
+                    cur = parents.get(cur)
+                    continue
+                cur = parents.get(cur)
+            return None
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and parents.get(node) is tree:
+                self.classes[node.name] = (
+                    None if node.name in self.classes else (rel, node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls = enclosing_class(node)
+                fn = _Fn(node, rel, cls, node.name)
+                # innermost def wins on duplicate names; fine for our tree
+                self.fns.setdefault(fn.key, fn)
+            elif isinstance(node, ast.Call):
+                fac = _factory_call(node)
+                kind_raw = _is_primitive_ctor(node)
+                if fac is not None:
+                    kind, lname = fac
+                    qn = qualname(node)
+                    self.construct_sites.append(
+                        (rel, qn, lname, kind, node.lineno))
+                    tgt = self._assign_target(parents.get(node), node)
+                    if tgt is not None:
+                        mode, owner, attr = tgt
+                        if lname is not None:
+                            if mode == "self":
+                                cls = enclosing_class(node)
+                                if cls:
+                                    self.attr_locks[(cls, attr)] = lname
+                            elif mode == "module":
+                                self.mod_locks[(rel, attr)] = lname
+                            # locals resolved lexically in _LockResolver
+                elif kind_raw is not None and rel != _LOCKCHECK:
+                    self.raw_sites.append(
+                        (rel, qualname(node), kind_raw, node.lineno))
+
+    @staticmethod
+    def _assign_target(parent: Optional[ast.AST], call: ast.Call):
+        """('self', None, attr) / ('module', None, name) for `X = <call>`
+        single-target (possibly annotated) assignments."""
+        if isinstance(parent, ast.AnnAssign) and parent.value is call:
+            t = parent.target
+        elif (isinstance(parent, ast.Assign) and parent.value is call
+                and len(parent.targets) == 1):
+            t = parent.targets[0]
+        else:
+            return None
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return ("self", None, t.attr)
+        if isinstance(t, ast.Name):
+            return ("module", None, t.id)
+        return None
+
+    def _index_attr_types(self, rel: str, tree: ast.Module) -> None:
+        for cls_entry in list(self.classes.values()):
+            if cls_entry is None or cls_entry[0] != rel:
+                continue
+            cpath, cnode = cls_entry
+            for node in ast.walk(cnode):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                tail = _name_of(node.value.func).rsplit(".", 1)[-1]
+                if tail in self.classes and self.classes[tail] is not None:
+                    self.attr_types[(cnode.name, node.targets[0].attr)] = tail
+
+    # -- lock/call resolution ---------------------------------------------------------
+
+    def resolve_lock(self, expr: ast.AST, fn: _Fn) -> Optional[str]:
+        """Lock name for a with/acquire context expression, or None."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self" and fn.cls:
+            return self.attr_locks.get((fn.cls, expr.attr))
+        if isinstance(expr, ast.Name):
+            got = self.mod_locks.get((fn.path, expr.id))
+            if got is not None:
+                return got
+            # local `x = make_lock("...")` in this function
+            for node in ast.walk(fn.node):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == expr.id
+                        and isinstance(node.value, ast.Call)):
+                    fac = _factory_call(node.value)
+                    if fac is not None and fac[1] is not None:
+                        return fac[1]
+        return None
+
+    def resolve_call(self, call: ast.Call, fn: _Fn) -> Optional[_Fn]:
+        """Callee _Fn for the call forms the closure walk understands."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            callee = self.fns.get((fn.path, None, f.id))
+            if callee is not None:
+                return callee
+            cls = self.classes.get(f.id)
+            if cls is not None:
+                return self.fns.get((cls[0], f.id, "__init__"))
+            # local nested def inside the same function
+            return self.fns.get((fn.path, fn.cls, f.id))
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "self" and fn.cls:
+                callee = self.fns.get((fn.path, fn.cls, f.attr))
+                if callee is not None:
+                    return callee
+                return None
+            if isinstance(base, ast.Attribute) and isinstance(
+                    base.value, ast.Name) and base.value.id == "self" \
+                    and fn.cls:
+                tcls = self.attr_types.get((fn.cls, base.attr))
+                if tcls and self.classes.get(tcls):
+                    return self.fns.get((self.classes[tcls][0], tcls, f.attr))
+                return None
+            if isinstance(base, ast.Name):
+                tcls = self._local_type(base.id, fn)
+                if tcls and self.classes.get(tcls):
+                    return self.fns.get((self.classes[tcls][0], tcls, f.attr))
+        return None
+
+    def _local_type(self, name: str, fn: _Fn) -> Optional[str]:
+        # the function's own assignments first, then the whole module — a
+        # nested def (a signal handler inside main()) closes over locals of
+        # its enclosing function, which are module-distant from fn.node
+        scopes: List[ast.AST] = [fn.node]
+        entry = self.files.get(fn.path)
+        if entry is not None:
+            scopes.append(entry[0])
+        for scope in scopes:
+            for node in ast.walk(scope):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == name
+                        and isinstance(node.value, ast.Call)):
+                    tail = _name_of(node.value.func).rsplit(".", 1)[-1]
+                    if tail in self.classes and self.classes[tail] is not None:
+                        return tail
+        return None
+
+    # -- the bounded closure ----------------------------------------------------------
+
+    def closure_locks(self, fn: _Fn, consts: Dict[str, bool] = None,
+                      _depth: int = 0, _stack: Optional[Set] = None,
+                      _memo: Optional[Dict] = None) -> Dict[str, List[str]]:
+        """lock name -> call chain (labels) for every lock this function can
+        acquire, walking same-tree callees up to _CLOSURE_DEPTH deep.
+        ``consts`` prunes `if param:` branches for literal boolean keyword
+        arguments (one level — the PR 9 include_stats=False contract)."""
+        consts = consts or {}
+        memo = _memo if _memo is not None else {}
+        key = (fn.key, frozenset(consts.items()))
+        if key in memo:
+            return memo[key]
+        stack = _stack if _stack is not None else set()
+        if fn.key in stack or _depth > _CLOSURE_DEPTH:
+            return {}
+        stack = stack | {fn.key}
+        out: Dict[str, List[str]] = {}
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.If):
+                test = node.test
+                skip_body = skip_else = False
+                if isinstance(test, ast.Name) and test.id in consts:
+                    skip_body = not consts[test.id]
+                    skip_else = consts[test.id]
+                elif (isinstance(test, ast.UnaryOp)
+                        and isinstance(test.op, ast.Not)
+                        and isinstance(test.operand, ast.Name)
+                        and test.operand.id in consts):
+                    skip_body = consts[test.operand.id]
+                    skip_else = not consts[test.operand.id]
+                if not skip_body:
+                    for child in node.body:
+                        visit(child)
+                if not skip_else:
+                    for child in node.orelse:
+                        visit(child)
+                return
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lname = self.resolve_lock(item.context_expr, fn)
+                    if lname is not None:
+                        out.setdefault(lname, [fn.label])
+            if isinstance(node, ast.Call):
+                nm = _name_of(node.func)
+                if nm.endswith(".acquire"):
+                    lname = self.resolve_lock(node.func.value, fn)
+                    if lname is not None:
+                        out.setdefault(lname, [fn.label])
+                callee = self.resolve_call(node, fn)
+                if callee is not None:
+                    sub_consts = {
+                        kw.arg: bool(kw.value.value) for kw in node.keywords
+                        if kw.arg and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, bool)
+                        and kw.arg in callee.params}
+                    sub = self.closure_locks(callee, sub_consts, _depth + 1,
+                                             stack, memo)
+                    for lname, chain in sub.items():
+                        out.setdefault(lname, [fn.label] + chain)
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.If, ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+                    visit(child)
+                elif isinstance(child, ast.If):
+                    visit(child)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt)
+        memo[key] = out
+        return out
+
+
+def _suppressible(index: _TreeIndex, findings: List[Finding]) -> List[Finding]:
+    """Repo-rule findings honor the standard suppression syntax: group by
+    path and re-apply the engine's directive parser with that file's lines."""
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for path, fs in by_path.items():
+        entry = index.files.get(path)
+        if entry is None:
+            out.extend(fs)
+            continue
+        out.extend(_apply_suppressions(entry[1], fs))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R9 — lock-order discipline + the registry drift gate. Locks are acquired
+# in strictly increasing rank order (lockcheck.LOCK_TABLE); the graph is
+# built from with/acquire sites plus the bounded call closure, so a
+# cross-module nesting (router holds its lock and calls into the breaker)
+# is an edge even though no single function shows both locks.
+# ---------------------------------------------------------------------------
+class R9LockOrder:
+    id = "R9"
+    repo_rule = True
+
+    def check_repo(self, root: str) -> List[Finding]:
+        index = _TreeIndex(root)
+        findings: List[Finding] = []
+        if index.registry_err:
+            return [Finding(rule=self.id, path=_LOCKCHECK, line=0, col=0,
+                            message=index.registry_err)]
+        findings.extend(self._drift(index))
+        findings.extend(self._graph(index))
+        return _suppressible(index, findings)
+
+    # -- registry drift ---------------------------------------------------------------
+
+    def _drift(self, index: _TreeIndex) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Dict[str, Tuple[str, str, str]] = {}
+        for path, qn, lname, kind, lineno in index.construct_sites:
+            if lname is None:
+                out.append(Finding(
+                    rule=self.id, path=path, line=lineno, col=0,
+                    message="lockcheck factory call without a literal lock "
+                            "name — the registry drift gate needs the string "
+                            "at the construction site"))
+                continue
+            entry = index.registry.get(lname)
+            if entry is None:
+                out.append(Finding(
+                    rule=self.id, path=path, line=lineno, col=0,
+                    message=f"lock {lname!r} constructed here but not "
+                            f"registered in lockcheck.LOCK_TABLE — register "
+                            f"an owner and a rank"))
+                continue
+            seen[lname] = (path, qn, kind)
+            if entry.get("kind") != kind:
+                out.append(Finding(
+                    rule=self.id, path=path, line=lineno, col=0,
+                    message=f"lock {lname!r} registered as kind "
+                            f"{entry.get('kind')!r} but constructed as "
+                            f"{kind!r}"))
+            want_site = str(entry.get("site", ""))
+            have_site = f"{path}:{qn}"
+            if want_site and want_site != have_site:
+                out.append(Finding(
+                    rule=self.id, path=path, line=lineno, col=0,
+                    message=f"lock {lname!r} registered at {want_site!r} but "
+                            f"constructed at {have_site!r} — update the "
+                            f"registry's site in the same PR"))
+        for lname, entry in sorted(index.registry.items()):
+            if lname not in seen:
+                out.append(Finding(
+                    rule=self.id, path=_LOCKCHECK, line=0, col=0,
+                    message=f"stale registry entry {lname!r} "
+                            f"({entry.get('site')}) — no construction site "
+                            f"in the tree; drop it or fix the site"))
+        for path, qn, kind, lineno in index.raw_sites:
+            out.append(Finding(
+                rule=self.id, path=path, line=lineno, col=0,
+                message=f"raw threading.{kind.capitalize()}() construction "
+                        f"in {qn} — route through "
+                        f"glint_word2vec_tpu.lockcheck (make_{kind}) so the "
+                        f"lock carries a registered owner and rank"))
+        return out
+
+    # -- the acquisition graph --------------------------------------------------------
+
+    def _graph(self, index: _TreeIndex) -> List[Finding]:
+        # edges: (outer, inner) -> (path, line) of the inner acquisition
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        memo: Dict = {}
+
+        def record(outer: str, inner: str, path: str, line: int,
+                   via: str) -> None:
+            edges.setdefault((outer, inner), (path, line, via))
+
+        for fn in index.fns.values():
+            self._walk_fn(index, fn, [], record, memo)
+
+        out: List[Finding] = []
+        ranks = {n: e.get("rank", 0) for n, e in index.registry.items()}
+        kinds = {n: e.get("kind", "lock") for n, e in index.registry.items()}
+        for (outer, inner), (path, line, via) in sorted(edges.items()):
+            if outer == inner:
+                if kinds.get(inner) != "rlock":
+                    out.append(Finding(
+                        rule=self.id, path=path, line=line, col=0,
+                        message=f"reentrant acquisition of non-reentrant "
+                                f"lock {inner!r} ({via}) — self-deadlock; "
+                                f"make it an rlock or restructure"))
+                continue
+            if ranks.get(inner, 0) <= ranks.get(outer, 0):
+                out.append(Finding(
+                    rule=self.id, path=path, line=line, col=0,
+                    message=f"lock-order inversion: {inner!r} "
+                            f"(rank {ranks.get(inner)}) acquired while "
+                            f"holding {outer!r} (rank {ranks.get(outer)}) "
+                            f"via {via} — ranks must strictly increase "
+                            f"(lockcheck.LOCK_TABLE); reorder the "
+                            f"acquisitions or re-rank with the reasoning"))
+        # cycles: with strictly-increasing ranks every cycle contains an
+        # inversion, but report the cycle explicitly so a re-ranking "fix"
+        # that leaves a loop is still caught
+        out.extend(self._cycles(edges))
+        return out
+
+    def _walk_fn(self, index: _TreeIndex, fn: _Fn, held: List[str],
+                 record, memo: Dict) -> None:
+        def visit(node: ast.AST, held: List[str]) -> None:
+            if isinstance(node, ast.With):
+                acquired: List[str] = []
+                for item in node.items:
+                    lname = index.resolve_lock(item.context_expr, fn)
+                    if lname is not None:
+                        if held:
+                            record(held[-1], lname, fn.path, node.lineno,
+                                   fn.label)
+                        acquired.append(lname)
+                inner = held + acquired
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call) and held:
+                callee = index.resolve_call(node, fn)
+                if callee is not None:
+                    sub_consts = {
+                        kw.arg: bool(kw.value.value) for kw in node.keywords
+                        if kw.arg and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, bool)
+                        and kw.arg in callee.params}
+                    for lname, chain in index.closure_locks(
+                            callee, sub_consts, 1, None, memo).items():
+                        record(held[-1], lname, fn.path, node.lineno,
+                               " -> ".join([fn.label] + chain))
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+                    visit(child, held)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt, held)
+
+    def _cycles(self, edges: Dict) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        out: List[Finding] = []
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in
+                 set(graph) | {b for bs in graph.values() for b in bs}}
+
+        def dfs(n: str, path: List[str]) -> Optional[List[str]]:
+            color[n] = GRAY
+            for m in sorted(graph.get(n, ())):
+                if color[m] == GRAY:
+                    return path[path.index(m):] + [m] if m in path else [n, m]
+                if color[m] == WHITE:
+                    cyc = dfs(m, path + [m])
+                    if cyc:
+                        return cyc
+            color[n] = BLACK
+            return None
+
+        for n in sorted(color):
+            if color[n] == WHITE:
+                cyc = dfs(n, [n])
+                if cyc:
+                    epath, eline, _ = edges[(cyc[0], cyc[1])]
+                    out.append(Finding(
+                        rule=self.id, path=epath, line=eline, col=0,
+                        message=f"lock-acquisition cycle: "
+                                f"{' -> '.join(cyc)} — potential deadlock"))
+                    break
+        return out
+
+
+# ---------------------------------------------------------------------------
+# R10 — signal-handler safety: the PR 9 bug class. A handler runs on the
+# main thread AT AN ARBITRARY POINT, including inside a critical section;
+# if its call closure can block on a non-reentrant lock that any normal
+# path holds, the process deadlocks exactly when the dump matters most.
+# ---------------------------------------------------------------------------
+class R10HandlerSafety:
+    id = "R10"
+    repo_rule = True
+
+    def check_repo(self, root: str) -> List[Finding]:
+        index = _TreeIndex(root)
+        if index.registry_err:
+            return []  # R9 reports the registry problem once
+        findings: List[Finding] = []
+        memo: Dict = {}
+        kinds = {n: e.get("kind", "lock") for n, e in index.registry.items()}
+        for path, (tree, _) in sorted(index.files.items()):
+            for fn in [f for f in index.fns.values() if f.path == path]:
+                for node in ast.walk(fn.node):
+                    if not (isinstance(node, ast.Call)
+                            and _name_of(node.func) in
+                            ("signal.signal", "signal")
+                            and len(node.args) == 2):
+                        continue
+                    handler = self._resolve_handler(index, fn, node.args[1])
+                    if handler is None:
+                        continue
+                    closure = index.closure_locks(handler, None, 0, None,
+                                                  memo)
+                    for lname, chain in sorted(closure.items()):
+                        if kinds.get(lname) == "rlock":
+                            continue
+                        findings.append(Finding(
+                            rule=self.id, path=path, line=node.lineno, col=0,
+                            message=f"signal handler "
+                                    f"{handler.label.split(':')[-1]!r} can "
+                                    f"acquire non-reentrant lock {lname!r} "
+                                    f"(via {' -> '.join(chain)}) — if the "
+                                    f"signal lands while the interrupted "
+                                    f"thread holds it, the handler "
+                                    f"deadlocks (the PR 9 bug); make the "
+                                    f"lock reentrant or keep it off the "
+                                    f"handler path"))
+        return _suppressible(index, findings)
+
+    @staticmethod
+    def _resolve_handler(index: _TreeIndex, fn: _Fn,
+                         expr: ast.AST) -> Optional[_Fn]:
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self" and fn.cls:
+            return index.fns.get((fn.path, fn.cls, expr.attr))
+        if isinstance(expr, ast.Name):
+            # a def in the same function/class/module scope
+            return (index.fns.get((fn.path, fn.cls, expr.id))
+                    or index.fns.get((fn.path, None, expr.id)))
+        return None
+
+
+# ---------------------------------------------------------------------------
+# R11 — shared-mutable discipline (per-file): the PR 12 bug class. In a
+# class that owns a thread, a deque/list/dict attribute mutated anywhere
+# must have every whole-collection access (append/pop/iterate/sorted/list)
+# under ONE lock attribute — or live in a documented snapshot helper. A
+# lock-free append plus a locked sorted() still races (the deque iterator
+# raises RuntimeError on concurrent mutation), which is why mutation sites
+# are held to the same lock as the reads.
+# ---------------------------------------------------------------------------
+class R11SharedMutable:
+    id = "R11"
+    _CTORS = {"deque", "list", "dict", "OrderedDict", "defaultdict"}
+    _MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+                 "pop", "popleft", "remove", "clear", "update", "setdefault"}
+    _READERS = {"sorted", "list", "tuple", "max", "min", "sum", "set"}
+
+    def applies(self, path: str) -> bool:
+        return path.startswith(_LIB)
+
+    def check(self, ctx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _check_class(self, ctx, cls: ast.ClassDef) -> List[Finding]:
+        if not self._owns_thread(cls):
+            return []
+        shared = self._shared_collections(cls)
+        if not shared:
+            return []
+        locks = self._lock_attrs(cls)
+        findings: List[Finding] = []
+        for attr in sorted(shared):
+            sites = self._access_sites(ctx, cls, attr, locks)
+            guards = {g for _, _, g, helper in sites if not helper}
+            for lineno, what, guard, helper in sites:
+                if helper:
+                    continue
+                if guard is None:
+                    findings.append(Finding(
+                        rule=self.id, path=ctx.path, line=lineno, col=0,
+                        message=f"{what} of shared collection "
+                                f"'self.{attr}' in thread-owning class "
+                                f"{cls.name} outside any lock — another "
+                                f"thread mutating it concurrently corrupts "
+                                f"state or raises (the PR 12 deque race); "
+                                f"hold the owning lock or go through a "
+                                f"documented snapshot helper"))
+                elif len(guards - {None}) > 1:
+                    findings.append(Finding(
+                        rule=self.id, path=ctx.path, line=lineno, col=0,
+                        message=f"'self.{attr}' in {cls.name} is guarded by "
+                                f"different locks at different sites "
+                                f"({sorted(g for g in guards if g)}) — one "
+                                f"collection, one lock"))
+        return findings
+
+    def _owns_thread(self, cls: ast.ClassDef) -> bool:
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                nm = _name_of(node.func)
+                if nm in ("threading.Thread", "Thread"):
+                    return True
+        return False
+
+    @staticmethod
+    def _self_attr_assign(node: ast.AST):
+        """(attr, value) for `self.x = v` / `self.x: T = v` assignments."""
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            t = node.target
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+        else:
+            return None
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return (t.attr, node.value)
+        return None
+
+    def _shared_collections(self, cls: ast.ClassDef) -> Set[str]:
+        assigned: Set[str] = set()
+        for node in ast.walk(cls):
+            pair = self._self_attr_assign(node)
+            if pair is not None:
+                attr, v = pair
+                is_coll = (isinstance(v, (ast.List, ast.Dict, ast.Set))
+                           or (isinstance(v, ast.Call)
+                               and _name_of(v.func).rsplit(".", 1)[-1]
+                               in self._CTORS))
+                if is_coll:
+                    assigned.add(attr)
+        mutated: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and \
+                    node.func.attr in self._MUTATORS:
+                base = node.func.value
+                if isinstance(base, ast.Attribute) and isinstance(
+                        base.value, ast.Name) and base.value.id == "self":
+                    mutated.add(base.attr)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Attribute) and isinstance(
+                            t.value.value, ast.Name) and \
+                            t.value.value.id == "self":
+                        mutated.add(t.value.attr)
+        return assigned & mutated
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            pair = self._self_attr_assign(node)
+            if pair is not None and isinstance(pair[1], ast.Call):
+                if (_factory_call(pair[1]) is not None
+                        or _is_primitive_ctor(pair[1]) is not None):
+                    out.add(pair[0])
+        return out
+
+    def _access_sites(self, ctx, cls: ast.ClassDef, attr: str,
+                      locks: Set[str]):
+        """(lineno, description, guarding lock attr or None, in_helper)."""
+        sites = []
+        for method in [n for n in cls.body if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            # the documented-snapshot escape: the method NAME says snapshot
+            # and a docstring exists to say why it is safe — a passing
+            # mention of "snapshot" in some other method's docstring is not
+            # a thread-safety argument
+            doc = ast.get_docstring(method) or ""
+            helper = "snapshot" in method.name and bool(doc)
+
+            def guard_of(node: ast.AST) -> Optional[str]:
+                cur = ctx.parents.get(node)
+                while cur is not None and cur is not method:
+                    if isinstance(cur, ast.With):
+                        for item in cur.items:
+                            e = item.context_expr
+                            if isinstance(e, ast.Attribute) and isinstance(
+                                    e.value, ast.Name) and \
+                                    e.value.id == "self" and e.attr in locks:
+                                return e.attr
+                    cur = ctx.parents.get(cur)
+                return None
+
+            def is_attr(node: ast.AST) -> bool:
+                return (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self" and node.attr == attr)
+
+            for node in ast.walk(method):
+                what = None
+                where = node
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and is_attr(f.value) \
+                            and f.attr in self._MUTATORS:
+                        what = f"mutation (.{f.attr})"
+                    elif (isinstance(f, ast.Name)
+                            and f.id in self._READERS and node.args):
+                        a = node.args[0]
+                        if is_attr(a):
+                            what = f"whole-collection read ({f.id}(...))"
+                        elif (isinstance(a, ast.Call) and isinstance(
+                                a.func, ast.Attribute)
+                                and a.func.attr in ("values", "items", "keys")
+                                and is_attr(a.func.value)):
+                            what = f"whole-collection read ({f.id}(...))"
+                elif isinstance(node, ast.For) and is_attr(node.iter):
+                    what = "iteration"
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    for gen in node.generators:
+                        if is_attr(gen.iter):
+                            what = "iteration (comprehension)"
+                elif (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Subscript)):
+                    t = node.targets[0]
+                    if is_attr(t.value):
+                        what = "mutation (subscript assignment)"
+                if what is not None:
+                    sites.append((where.lineno, what, guard_of(where),
+                                  helper))
+        return sites
+
+
+# ---------------------------------------------------------------------------
+# R1 staleness (repo rule, same id as the per-file R1): an allowlist entry
+# blessing a thread owner that no longer exists used to rot silently —
+# the blessing then silently covers whatever def NEXT takes that name.
+# ---------------------------------------------------------------------------
+class R1Staleness:
+    id = "R1"
+    repo_rule = True
+
+    def __init__(self, allowlist=None):
+        if allowlist is None:
+            from tools.graftlint.rules import R1ThreadPools
+            allowlist = R1ThreadPools._ALLOW
+        self._allow = allowlist
+
+    def check_repo(self, root: str) -> List[Finding]:
+        findings: List[Finding] = []
+        trees: Dict[str, Optional[ast.Module]] = {}
+        for path, qual in sorted(self._allow):
+            if path not in trees:
+                abspath = os.path.join(root, *path.split("/"))
+                try:
+                    with open(abspath, "r", encoding="utf-8") as f:
+                        trees[path] = ast.parse(f.read())
+                except (OSError, SyntaxError):
+                    trees[path] = None
+            tree = trees[path]
+            if tree is None:
+                findings.append(Finding(
+                    rule=self.id, path=path, line=0, col=0,
+                    message=f"stale R1 allowlist entry: {path!r} cannot be "
+                            f"parsed/found, but ({path!r}, {qual!r}) still "
+                            f"blesses a thread owner there"))
+                continue
+            if not self._qual_exists(tree, qual):
+                findings.append(Finding(
+                    rule=self.id, path=path, line=0, col=0,
+                    message=f"stale R1 allowlist entry: no def "
+                            f"{qual!r} in {path} — the blessing would "
+                            f"silently cover whatever next takes the name; "
+                            f"drop or update the allowlist entry"))
+        return findings
+
+    @staticmethod
+    def _qual_exists(tree: ast.Module, qual: str) -> bool:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            parts = [node.name]
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    parts.append(cur.name)
+                cur = parents.get(cur)
+            if ".".join(reversed(parts)) == qual:
+                return True
+        return False
+
+
+CONCURRENCY_RULES = [R9LockOrder(), R10HandlerSafety(), R11SharedMutable(),
+                     R1Staleness()]

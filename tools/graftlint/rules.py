@@ -458,7 +458,7 @@ class R7JsonStdout:
         "tools/telemetry_run.py", "tools/graftcheck/__main__.py",
         "tools/run_report.py", "tools/perfgate.py", "tools/servebench.py",
         "tools/continual_run.py", "tools/fleet_run.py",
-        "tools/obs_collect.py",
+        "tools/obs_collect.py", "tools/racecheck.py",
     }
 
     def applies(self, path: str) -> bool:
@@ -685,6 +685,10 @@ class R8RefusalParity:
         return out
 
 
+from tools.graftlint.concurrency import CONCURRENCY_RULES  # noqa: E402 — the
+# graftrace layer (R9–R11 + R1 staleness) lives in its own module; imported
+# at the bottom so concurrency.py can use _name_of/R1ThreadPools from here
+
 ALL_RULES = [R1ThreadPools(), R2Prng(), R3TracerDiscipline(), R4PrefixDtype(),
              R5RetryIO(), R6DispatchDiscipline(), R7JsonStdout(),
-             R8RefusalParity()]
+             R8RefusalParity()] + CONCURRENCY_RULES

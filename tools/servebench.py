@@ -69,6 +69,7 @@ if _REPO not in sys.path:
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np  # noqa: E402
+from glint_word2vec_tpu.lockcheck import make_lock
 
 
 def log(msg: str) -> None:
@@ -147,7 +148,7 @@ def offered_load(service, words: List[str], num: int, target_qps: float,
     n = max(1, int(target_qps * duration_s))
     start = time.monotonic() + 0.05
     arrivals = [start + i / target_qps for i in range(n)]
-    lock = threading.Lock()
+    lock = make_lock("tools.servebench.tickets")
     nxt = [0]
     lats: List[float] = []
     refused = [0]
